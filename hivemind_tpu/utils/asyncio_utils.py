@@ -1,0 +1,195 @@
+"""Asyncio helpers (capability parity: reference hivemind/utils/asyncio.py). uvloop is
+not available in this environment; the stock loop is used (switch_to_uvloop kept as a
+no-op shim so call sites stay portable).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import os
+from concurrent.futures import ThreadPoolExecutor
+from contextlib import asynccontextmanager
+from typing import AsyncIterable, AsyncIterator, Awaitable, Callable, Optional, Tuple, TypeVar, Union
+
+T = TypeVar("T")
+
+
+def switch_to_uvloop() -> asyncio.AbstractEventLoop:
+    """Create a fresh event loop for the current thread (uvloop unavailable on this image)."""
+    try:
+        import uvloop  # type: ignore
+
+        asyncio.set_event_loop_policy(uvloop.EventLoopPolicy())
+    except ImportError:
+        pass
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    return loop
+
+
+async def anext_safe(aiter: AsyncIterator[T]) -> Union[T, object]:
+    """Like anext() but returns the sentinel instead of raising StopAsyncIteration."""
+    try:
+        return await aiter.__anext__()
+    except StopAsyncIteration:
+        return _SENTINEL
+
+
+_SENTINEL = object()
+
+
+async def as_aiter(*items: T) -> AsyncIterator[T]:
+    for item in items:
+        yield item
+
+
+async def azip(*iterables: AsyncIterable) -> AsyncIterator[Tuple]:
+    iterators = [it.__aiter__() for it in iterables]
+    while True:
+        results = await asyncio.gather(*(anext_safe(it) for it in iterators))
+        if any(r is _SENTINEL for r in results):
+            return
+        yield tuple(results)
+
+
+async def achain(*iterables: AsyncIterable[T]) -> AsyncIterator[T]:
+    for it in iterables:
+        async for item in it:
+            yield item
+
+
+async def aenumerate(iterable: AsyncIterable[T], start: int = 0) -> AsyncIterator[Tuple[int, T]]:
+    index = start
+    async for item in iterable:
+        yield index, item
+        index += 1
+
+
+async def aiter_with_timeout(iterable: AsyncIterable[T], timeout: Optional[float]) -> AsyncIterator[T]:
+    """Iterate over an async iterable, raising asyncio.TimeoutError if any single item
+    takes longer than ``timeout`` seconds."""
+    iterator = iterable.__aiter__()
+    while True:
+        item = await asyncio.wait_for(anext_safe(iterator), timeout=timeout)
+        if item is _SENTINEL:
+            return
+        yield item
+
+
+async def amap_in_executor(
+    fn: Callable[..., T],
+    *iterables: AsyncIterable,
+    max_prefetch: int = 1,
+    executor: Optional[ThreadPoolExecutor] = None,
+) -> AsyncIterator[T]:
+    """Apply a blocking fn to items of async iterable(s) in a thread executor with
+    bounded prefetch — used to overlap compression with networking
+    (reference asyncio.py:104-143)."""
+    assert max_prefetch > 0
+    loop = asyncio.get_event_loop()
+    queue: asyncio.Queue = asyncio.Queue(max_prefetch)
+
+    async def _producer():
+        try:
+            async for args in azip(*iterables):
+                await queue.put(loop.run_in_executor(executor, fn, *args))
+            await queue.put(None)
+        except asyncio.CancelledError:
+            # consumer is gone; never block on a full queue in cleanup
+            try:
+                queue.put_nowait(None)
+            except asyncio.QueueFull:
+                pass
+            raise
+
+    producer = asyncio.create_task(_producer())
+    try:
+        while True:
+            future = await queue.get()
+            if future is None:
+                break
+            yield await future
+        await producer
+    finally:
+        if not producer.done():
+            producer.cancel()
+
+
+async def cancel_and_wait(task: asyncio.Task) -> bool:
+    """Cancel a task and wait until the cancellation lands. Returns True if it was
+    cancelled (vs finished/failed first)."""
+    task.cancel()
+    try:
+        await task
+        return False
+    except asyncio.CancelledError:
+        return True
+    except BaseException:
+        return False
+
+
+async def await_cancelled(awaitable: Awaitable) -> bool:
+    try:
+        await awaitable
+        return False
+    except asyncio.CancelledError:
+        return True
+    except BaseException:
+        return False
+
+
+_blocking_executor = ThreadPoolExecutor(
+    max_workers=int(os.getenv("HIVEMIND_TPU_BLOCKING_THREADS", "32")),
+    thread_name_prefix="hmtpu-blocking",
+)
+
+
+async def run_in_executor(fn: Callable[..., T], *args) -> T:
+    """Run a blocking function in the shared background thread pool."""
+    return await asyncio.get_event_loop().run_in_executor(_blocking_executor, fn, *args)
+
+
+# lock acquisition can block indefinitely, so it must never share a bounded pool with
+# productive work (reference asyncio.py:166-198 uses an unbounded executor for this)
+_lock_executor = ThreadPoolExecutor(max_workers=2**16, thread_name_prefix="hmtpu-lock")
+
+
+@asynccontextmanager
+async def enter_asynchronously(lock):
+    """Acquire a synchronous threading.Lock without blocking the event loop."""
+    await asyncio.get_event_loop().run_in_executor(_lock_executor, lock.acquire)
+    try:
+        yield lock
+    finally:
+        lock.release()
+
+
+async def attach_event_on_finished(iterable: AsyncIterable[T], event: asyncio.Event) -> AsyncIterator[T]:
+    """Yield from iterable; set the event when iteration ends for any reason."""
+    try:
+        async for item in iterable:
+            yield item
+    finally:
+        event.set()
+
+
+def complete_future_threadsafe(future: Union[asyncio.Future, concurrent.futures.Future], result=None, exception=None):
+    """Set a result/exception on a future from any thread."""
+    if isinstance(future, concurrent.futures.Future):
+        if exception is not None:
+            future.set_exception(exception)
+        else:
+            future.set_result(result)
+        return
+    loop = future.get_loop()
+
+    def _set():
+        if future.done():
+            return
+        if exception is not None:
+            future.set_exception(exception)
+        else:
+            future.set_result(result)
+
+    loop.call_soon_threadsafe(_set)

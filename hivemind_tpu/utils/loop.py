@@ -1,0 +1,156 @@
+"""The process-wide event-loop runtime.
+
+The reference runs every service (DHT, averager, connection handlers) as a forked
+daemon process with its own uvloop, bridged by pipes + a shared-memory ``MPFuture``
+(reference hivemind/utils/mpfuture.py:65-328, dht/dht.py:89-139). That topology exists
+to dodge the GIL and CUDA-fork hazards. On TPU the process model is the opposite: one
+process owns the accelerator, and forking after jax initialization is unsafe. So the
+runtime here is a single shared asyncio event loop on a background thread; components
+schedule coroutines onto it and sync callers get ``concurrent.futures.Future`` handles
+(the MPFuture equivalent without crossing a process boundary).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import atexit
+import concurrent.futures
+import threading
+from typing import Any, Awaitable, Coroutine, Optional, TypeVar
+
+from hivemind_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+T = TypeVar("T")
+
+
+class EventLoopShutdownError(RuntimeError):
+    """Raised when scheduling onto a loop runner that has shut down."""
+
+
+class LoopRunner:
+    """An asyncio event loop running on a dedicated daemon thread.
+
+    ``run_coroutine(coro)`` returns a concurrent Future (sync handle);
+    ``run_coroutine(coro, return_future=True)`` returns it without waiting.
+    """
+
+    def __init__(self, name: str = "hmtpu-loop"):
+        self._name = name
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self._closed = False
+        self._start_lock = threading.Lock()
+
+    @property
+    def loop(self) -> asyncio.AbstractEventLoop:
+        self._ensure_started()
+        assert self._loop is not None
+        return self._loop
+
+    def _ensure_started(self) -> None:
+        if self._started.is_set():
+            return
+        with self._start_lock:
+            if self._started.is_set():
+                return
+            if self._closed:
+                raise EventLoopShutdownError(f"{self._name} is shut down")
+
+            def _run():
+                loop = asyncio.new_event_loop()
+                asyncio.set_event_loop(loop)
+                self._loop = loop
+                self._started.set()
+                try:
+                    loop.run_forever()
+                finally:
+                    try:
+                        pending = asyncio.all_tasks(loop)
+                        for task in pending:
+                            task.cancel()
+                        if pending:
+                            loop.run_until_complete(
+                                asyncio.gather(*pending, return_exceptions=True)
+                            )
+                    finally:
+                        loop.close()
+
+            self._thread = threading.Thread(target=_run, name=self._name, daemon=True)
+            self._thread.start()
+            self._started.wait()
+
+    def run_coroutine(
+        self, coro: Coroutine[Any, Any, T], return_future: bool = False
+    ) -> Any:
+        """Schedule a coroutine onto the loop. Returns the result (blocking) or a
+        concurrent.futures.Future if return_future=True."""
+        self._ensure_started()
+        if self._closed:
+            raise EventLoopShutdownError(f"{self._name} is shut down")
+        future = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return future if return_future else future.result()
+
+    def call_soon(self, callback, *args) -> None:
+        self._ensure_started()
+        self.loop.call_soon_threadsafe(callback, *args)
+
+    @property
+    def is_running(self) -> bool:
+        return self._started.is_set() and not self._closed
+
+    def in_loop(self) -> bool:
+        """True if the caller is already on this runner's loop thread."""
+        return threading.current_thread() is self._thread
+
+    def shutdown(self, timeout: float = 5.0) -> None:
+        if self._closed or not self._started.is_set():
+            self._closed = True
+            return
+        self._closed = True
+        assert self._loop is not None
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+
+_global_runner: Optional[LoopRunner] = None
+_global_lock = threading.Lock()
+
+
+def get_loop_runner() -> LoopRunner:
+    """The process-wide shared loop runner (created lazily)."""
+    global _global_runner
+    with _global_lock:
+        if _global_runner is None or not _global_runner.is_running:
+            _global_runner = LoopRunner()
+        return _global_runner
+
+
+def reset_loop_runner() -> None:
+    """Tear down the global runner (test isolation)."""
+    global _global_runner
+    with _global_lock:
+        if _global_runner is not None:
+            _global_runner.shutdown()
+            _global_runner = None
+
+
+@atexit.register
+def _shutdown_at_exit():
+    global _global_runner
+    if _global_runner is not None:
+        _global_runner.shutdown(timeout=1.0)
+        _global_runner = None
+
+
+def as_concurrent_future(awaitable: Awaitable[T], runner: Optional[LoopRunner] = None) -> concurrent.futures.Future:
+    """Bridge an awaitable to a thread-safe concurrent future on the shared loop."""
+    runner = runner or get_loop_runner()
+
+    async def _wrap():
+        return await awaitable
+
+    return runner.run_coroutine(_wrap(), return_future=True)

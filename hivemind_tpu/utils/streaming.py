@@ -1,0 +1,22 @@
+"""Split serialized tensors into stream-sized chunks and combine them back
+(capability parity: reference hivemind/utils/streaming.py:14-46)."""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, List, TypeVar
+
+STREAMING_CHUNK_SIZE_BYTES = 2**16
+
+
+def split_for_streaming(data: bytes, chunk_size_bytes: int = STREAMING_CHUNK_SIZE_BYTES) -> Iterator[bytes]:
+    """Split a byte string into chunks of at most chunk_size_bytes. Always yields at
+    least one (possibly empty) chunk."""
+    if not data:
+        yield b""
+        return
+    for offset in range(0, len(data), chunk_size_bytes):
+        yield data[offset : offset + chunk_size_bytes]
+
+
+def combine_from_streaming(chunks: Iterable[bytes]) -> bytes:
+    return b"".join(chunks)

@@ -1,0 +1,125 @@
+"""Tensor schemas: shape/dtype descriptors used by MoE expert signatures, averaging
+schema hashes, and RPC (de)serialization (capability parity: reference
+hivemind/utils/tensor_descr.py:27-135). jax-native: dtypes are canonical numpy/jax
+dtype names (bfloat16 included), arrays are created with jax.numpy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+DUMMY_BATCH_SIZE = 3  # batch size used when tracing expert schemas with dummy inputs
+
+
+def _canonical_dtype_name(dtype: Any) -> str:
+    """Normalize numpy/jax/str dtypes to a canonical string name ('float32', 'bfloat16', ...)."""
+    if isinstance(dtype, str):
+        name = dtype
+    else:
+        name = np.dtype(dtype).name if not _is_bfloat16(dtype) else "bfloat16"
+    if name == "bfloat16":
+        return name
+    return np.dtype(name).name
+
+
+def _is_bfloat16(dtype: Any) -> bool:
+    try:
+        import ml_dtypes
+
+        return np.dtype(dtype) == np.dtype(ml_dtypes.bfloat16)
+    except Exception:
+        return str(dtype) == "bfloat16"
+
+
+def numpy_dtype(name: str):
+    """The numpy dtype object for a canonical name (supports bfloat16 via ml_dtypes)."""
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorDescriptor:
+    """Declarative description of an array: enough to allocate it or validate a peer's."""
+
+    shape: Tuple[int, ...]
+    dtype: str = "float32"
+    requires_grad: bool = False
+    compression: Optional[int] = None  # CompressionType value, see hivemind_tpu.compression
+
+    def __post_init__(self):
+        object.__setattr__(self, "shape", tuple(int(d) for d in self.shape))
+        object.__setattr__(self, "dtype", _canonical_dtype_name(self.dtype))
+
+    @classmethod
+    def from_array(cls, array: Any, compression: Optional[int] = None) -> "TensorDescriptor":
+        dtype = "bfloat16" if str(array.dtype) == "bfloat16" else str(np.dtype(array.dtype))
+        requires_grad = bool(getattr(array, "requires_grad", False))
+        return cls(tuple(array.shape), dtype, requires_grad, compression)
+
+    @property
+    def numel(self) -> int:
+        out = 1
+        for dim in self.shape:
+            out *= dim
+        return out
+
+    @property
+    def itemsize(self) -> int:
+        return numpy_dtype(self.dtype).itemsize
+
+    @property
+    def nbytes(self) -> int:
+        return self.numel * self.itemsize
+
+    def make_zeros(self, backend: str = "numpy"):
+        if backend == "jax":
+            import jax.numpy as jnp
+
+            return jnp.zeros(self.shape, dtype=self.dtype)
+        return np.zeros(self.shape, dtype=numpy_dtype(self.dtype))
+
+    def packb(self) -> bytes:
+        from hivemind_tpu.utils.serializer import MSGPackSerializer
+
+        return MSGPackSerializer.dumps(
+            [list(self.shape), self.dtype, self.requires_grad, self.compression]
+        )
+
+    @classmethod
+    def unpackb(cls, data: bytes) -> "TensorDescriptor":
+        from hivemind_tpu.utils.serializer import MSGPackSerializer
+
+        shape, dtype, requires_grad, compression = MSGPackSerializer.loads(data)
+        return cls(tuple(shape), dtype, requires_grad, compression)
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchTensorDescriptor(TensorDescriptor):
+    """A TensorDescriptor whose leading (batch) dimension is unspecified: shape[0] is
+    stored as 0 and means 'any batch size'."""
+
+    def __post_init__(self):
+        super().__post_init__()
+
+    @classmethod
+    def from_array(cls, array: Any, compression: Optional[int] = None) -> "BatchTensorDescriptor":
+        base = TensorDescriptor.from_array(array, compression)
+        return cls((0, *base.shape[1:]), base.dtype, base.requires_grad, compression)
+
+    def with_batch_size(self, batch_size: int) -> TensorDescriptor:
+        return TensorDescriptor((batch_size, *self.shape[1:]), self.dtype, self.requires_grad, self.compression)
+
+    def make_dummy(self, backend: str = "numpy"):
+        return self.with_batch_size(DUMMY_BATCH_SIZE).make_zeros(backend)
+
+
+from hivemind_tpu.utils.serializer import MSGPackSerializer  # noqa: E402
+
+MSGPackSerializer.ext_serializable(0x51)(TensorDescriptor)
+MSGPackSerializer.ext_serializable(0x52)(BatchTensorDescriptor)

@@ -1,0 +1,134 @@
+"""A dictionary with value expiration times — the storage primitive beneath the DHT,
+caches, blacklists and leader queues (capability parity: reference
+hivemind/utils/timed_storage.py:50-143).
+"""
+
+from __future__ import annotations
+
+import heapq
+import time
+from contextlib import contextmanager
+from typing import Generic, Iterator, NamedTuple, Optional, Tuple, TypeVar
+
+KeyType = TypeVar("KeyType")
+ValueType = TypeVar("ValueType")
+
+DHTExpiration = float
+MAX_DHT_TIME_DISCREPANCY_SECONDS = 3.0  # max tolerated clock skew between peers
+
+
+def get_dht_time() -> DHTExpiration:
+    """Global swarm time. Approximated as local UNIX time; peers tolerate up to
+    MAX_DHT_TIME_DISCREPANCY_SECONDS of skew (reference timed_storage.py:13-14)."""
+    return time.time()
+
+
+class ValueWithExpiration(NamedTuple, Generic[ValueType]):
+    value: ValueType
+    expiration_time: DHTExpiration
+
+    def __eq__(self, other):
+        if isinstance(other, ValueWithExpiration):
+            return self.value == other.value and self.expiration_time == other.expiration_time
+        if isinstance(other, tuple):
+            return tuple(self) == other
+        return False
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+    def __hash__(self):
+        return hash((self.value, self.expiration_time))
+
+
+class _HeapEntry(NamedTuple, Generic[KeyType]):
+    expiration_time: DHTExpiration
+    key: KeyType
+
+
+class TimedStorage(Generic[KeyType, ValueType]):
+    """A dict that evicts expired values lazily and the soonest-to-expire value when
+    over ``maxsize``. ``freeze()`` suspends eviction for consistent multi-step reads."""
+
+    frozen = False  # class-level default; instances toggle via freeze()
+
+    def __init__(self, maxsize: Optional[int] = None):
+        self.maxsize = maxsize
+        self._data: dict[KeyType, ValueWithExpiration[ValueType]] = {}
+        self._expiration_heap: list[_HeapEntry[KeyType]] = []
+
+    def _remove_outdated(self) -> None:
+        if self.frozen:
+            return
+        now = get_dht_time()
+        while self._expiration_heap:
+            entry = self._expiration_heap[0]
+            current = self._data.get(entry.key)
+            if current is not None and current.expiration_time == entry.expiration_time:
+                # live heap entry: evict only if expired or oversize
+                if entry.expiration_time > now and not (
+                    self.maxsize is not None and len(self._data) > self.maxsize
+                ):
+                    break
+                del self._data[entry.key]
+            heapq.heappop(self._expiration_heap)
+
+    def store(self, key: KeyType, value: ValueType, expiration_time: DHTExpiration) -> bool:
+        """Store (key, value) until expiration_time, unless a fresher value exists.
+        Returns True if stored."""
+        if expiration_time < get_dht_time() and not self.frozen:
+            return False
+        previous = self._data.get(key)
+        if previous is not None and previous.expiration_time > expiration_time:
+            return False
+        self._data[key] = ValueWithExpiration(value, expiration_time)
+        heapq.heappush(self._expiration_heap, _HeapEntry(expiration_time, key))
+        self._remove_outdated()
+        return True
+
+    def get(self, key: KeyType) -> Optional[ValueWithExpiration[ValueType]]:
+        self._remove_outdated()
+        return self._data.get(key)
+
+    def items(self) -> Iterator[Tuple[KeyType, ValueWithExpiration[ValueType]]]:
+        self._remove_outdated()
+        return iter(self._data.items())
+
+    def top(self) -> Optional[Tuple[KeyType, ValueWithExpiration[ValueType]]]:
+        """The entry with the soonest expiration, or None."""
+        self._remove_outdated()
+        while self._expiration_heap:
+            entry = self._expiration_heap[0]
+            current = self._data.get(entry.key)
+            if current is not None and current.expiration_time == entry.expiration_time:
+                return entry.key, current
+            heapq.heappop(self._expiration_heap)
+        return None
+
+    def __contains__(self, key: KeyType) -> bool:
+        self._remove_outdated()
+        return key in self._data
+
+    def __len__(self) -> int:
+        self._remove_outdated()
+        return len(self._data)
+
+    def __delitem__(self, key: KeyType) -> None:
+        self._remove_outdated()
+        del self._data[key]
+        # stale heap entries are pruned lazily
+
+    def __bool__(self) -> bool:
+        return len(self) > 0
+
+    @contextmanager
+    def freeze(self):
+        """Within this context, no values are evicted (consistent reads across awaits)."""
+        previous, self.frozen = self.frozen, True
+        try:
+            yield self
+        finally:
+            self.frozen = previous
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({len(self._data)} items, maxsize={self.maxsize})"

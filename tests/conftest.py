@@ -1,0 +1,46 @@
+"""Test harness: all tests run on a virtual 8-device CPU mesh so multi-chip sharding
+logic is exercised without TPU hardware (the driver separately dry-runs the multichip
+path; see __graft_entry__.py). Must set env BEFORE jax import."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import asyncio  # noqa: E402
+import gc  # noqa: E402
+import inspect  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Native asyncio test support (pytest-asyncio is not installed on this image):
+    `async def` tests run under asyncio.run with a fresh loop."""
+    if inspect.iscoroutinefunction(pyfuncitem.obj):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(pyfuncitem.obj(**kwargs))
+        return True
+    return None
+
+
+@pytest.fixture(autouse=True)
+def cleanup_children():
+    """Reset process-wide singletons between tests (reference tests/conftest.py:14-33)."""
+    yield
+    from hivemind_tpu.utils.crypto import Ed25519PrivateKey
+
+    Ed25519PrivateKey.reset_process_wide()
+    gc.collect()
+
+
+@pytest.fixture
+def event_loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
