@@ -1,0 +1,12 @@
+from hivemind_tpu.p2p.crypto_channel import HandshakeError
+from hivemind_tpu.p2p.mux import RemoteError, StreamClosedError
+from hivemind_tpu.p2p.p2p import (
+    DEFAULT_MAX_MSG_SIZE,
+    P2P,
+    P2PContext,
+    P2PError,
+    P2PHandlerError,
+    PeerNotFoundError,
+)
+from hivemind_tpu.p2p.peer_id import Multiaddr, PeerID
+from hivemind_tpu.p2p.servicer import ServicerBase, StubBase

@@ -1,0 +1,159 @@
+"""Authenticated encrypted channel over a TCP connection.
+
+The reference delegates transport security to the Go daemon (TLS1.3 / noise inside
+go-libp2p, hivemind/p2p/p2p_daemon.py:99). Here the equivalent is a Noise-style
+XX-pattern handshake implemented with the ``cryptography`` primitives:
+
+1. both sides exchange a plaintext hello: {ed25519 static pub, x25519 ephemeral pub,
+   sig = Ed25519_sign(transcript_prefix || x25519_pub)}, proving static-key possession.
+2. shared secret = X25519(own ephemeral, peer ephemeral); two ChaCha20-Poly1305 keys
+   are derived with HKDF-SHA256 (one per direction), giving forward secrecy.
+3. every subsequent frame is AEAD-sealed with a per-direction 64-bit counter nonce and
+   the 4-byte length header as associated data.
+
+Frame wire format: [u32 big-endian ciphertext length][ciphertext].
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Optional, Tuple
+
+from cryptography.exceptions import InvalidTag
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric.x25519 import X25519PrivateKey, X25519PublicKey
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+from hivemind_tpu.utils.crypto import Ed25519PrivateKey, Ed25519PublicKey
+from hivemind_tpu.utils.serializer import MSGPackSerializer
+
+MAX_FRAME_SIZE = 16 * 1024 * 1024  # hard cap on one encrypted frame
+_HANDSHAKE_PREFIX = b"hivemind-tpu-noise-v1:"
+
+
+class HandshakeError(RuntimeError):
+    pass
+
+
+class SecureChannel:
+    """Length-prefixed AEAD frames over an asyncio stream pair. Use ``handshake`` to
+    construct. ``send``/``recv`` exchange whole messages (frames)."""
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        send_key: bytes,
+        recv_key: bytes,
+        peer_public_key: Ed25519PublicKey,
+    ):
+        self._reader = reader
+        self._writer = writer
+        self._send_aead = ChaCha20Poly1305(send_key)
+        self._recv_aead = ChaCha20Poly1305(recv_key)
+        self._send_counter = 0
+        self._recv_counter = 0
+        self.peer_public_key = peer_public_key
+        self._send_lock = asyncio.Lock()
+
+    async def send(self, payload: bytes) -> None:
+        async with self._send_lock:
+            nonce = struct.pack("<4xQ", self._send_counter)
+            self._send_counter += 1
+            ciphertext = self._send_aead.encrypt(nonce, payload, None)
+            if len(ciphertext) > MAX_FRAME_SIZE:
+                raise ValueError(f"frame too large: {len(ciphertext)} > {MAX_FRAME_SIZE}")
+            header = struct.pack(">I", len(ciphertext))
+            self._writer.write(header + ciphertext)
+            await self._writer.drain()
+
+    async def recv(self) -> bytes:
+        header = await self._reader.readexactly(4)
+        (length,) = struct.unpack(">I", header)
+        if length > MAX_FRAME_SIZE:
+            raise HandshakeError(f"oversized frame: {length}")
+        ciphertext = await self._reader.readexactly(length)
+        nonce = struct.pack("<4xQ", self._recv_counter)
+        self._recv_counter += 1
+        try:
+            return self._recv_aead.decrypt(nonce, ciphertext, None)
+        except InvalidTag:
+            raise HandshakeError("AEAD authentication failed (corrupted or replayed frame)")
+
+    def close(self) -> None:
+        try:
+            self._writer.close()
+        except Exception:
+            pass
+
+    async def wait_closed(self) -> None:
+        try:
+            await self._writer.wait_closed()
+        except Exception:
+            pass
+
+
+async def _send_plain(writer: asyncio.StreamWriter, payload: bytes) -> None:
+    writer.write(struct.pack(">I", len(payload)) + payload)
+    await writer.drain()
+
+
+async def _recv_plain(reader: asyncio.StreamReader, max_size: int = 4096) -> bytes:
+    header = await reader.readexactly(4)
+    (length,) = struct.unpack(">I", header)
+    if length > max_size:
+        raise HandshakeError(f"oversized handshake frame: {length}")
+    return await reader.readexactly(length)
+
+
+async def handshake(
+    reader: asyncio.StreamReader,
+    writer: asyncio.StreamWriter,
+    identity: Ed25519PrivateKey,
+    is_initiator: bool,
+    announced_addrs: Optional[list] = None,
+    timeout: float = 15.0,
+) -> Tuple[SecureChannel, dict]:
+    """Perform the mutual-authentication handshake. Returns (channel, peer_hello_extras)
+    where extras carries the peer's announced listen addresses."""
+
+    async def _run() -> Tuple[SecureChannel, dict]:
+        ephemeral = X25519PrivateKey.generate()
+        eph_pub = ephemeral.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+        hello = {
+            "static": identity.get_public_key().to_bytes(),
+            "ephemeral": eph_pub,
+            "sig": identity.sign(_HANDSHAKE_PREFIX + eph_pub),
+            "addrs": [str(a) for a in (announced_addrs or [])],
+            "version": 1,
+        }
+        await _send_plain(writer, MSGPackSerializer.dumps(hello))
+        peer_hello = MSGPackSerializer.loads(await _recv_plain(reader))
+
+        peer_static = Ed25519PublicKey.from_bytes(peer_hello["static"])
+        if not peer_static.verify(_HANDSHAKE_PREFIX + peer_hello["ephemeral"], peer_hello["sig"]):
+            raise HandshakeError("peer failed static key proof")
+
+        peer_eph = X25519PublicKey.from_public_bytes(peer_hello["ephemeral"])
+        shared = ephemeral.exchange(peer_eph)
+        okm = HKDF(
+            algorithm=hashes.SHA256(), length=64, salt=b"hivemind-tpu-hs", info=b"channel-keys"
+        ).derive(shared)
+        initiator_key, responder_key = okm[:32], okm[32:]
+        send_key, recv_key = (
+            (initiator_key, responder_key) if is_initiator else (responder_key, initiator_key)
+        )
+        channel = SecureChannel(reader, writer, send_key, recv_key, peer_static)
+        # key confirmation: proves the peer holds the ephemeral private key, which a
+        # replayed hello cannot (helloes alone are replayable — sig covers only the
+        # static prefix + own ephemeral). Both sides send first, then verify.
+        await channel.send(b"confirm")
+        if await channel.recv() != b"confirm":
+            raise HandshakeError("peer failed key confirmation")
+        return channel, {"addrs": peer_hello.get("addrs", []), "static": peer_hello["static"]}
+
+    return await asyncio.wait_for(_run(), timeout=timeout)

@@ -1,0 +1,269 @@
+"""Message-oriented stream multiplexing over one secure channel per peer pair.
+
+The reference gets multiplexing from go-libp2p (yamux/mplex inside the daemon) plus a
+persistent control connection for unary calls (p2p_daemon_bindings/control.py:172-311).
+Here both collapse into one mechanism: lightweight in-process streams over a single
+encrypted TCP connection. Frames are whole messages (an RPC message = one frame), which
+removes the reference's 8-byte-header + marker reframing layer entirely.
+
+Mux frame layout (inside the AEAD envelope): [u64 stream_id][u8 flags][payload].
+Flags: OPEN (payload = handler name utf-8), DATA (payload = message), CLOSE (graceful
+end-of-stream from that side), RESET (abort), ERROR (payload = msgpack error info).
+Flow control: per-stream inboxes are unbounded (the read loop never head-of-line-blocks
+one stream on another), with a per-connection buffered-bytes cap as the memory backstop
+— a peer that overruns it loses the connection, not the process. TCP backpressure plus
+eager reads in the RPC layer keep buffers small in practice.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from enum import IntFlag
+from typing import AsyncIterator, Awaitable, Callable, Dict, Optional
+
+from hivemind_tpu.p2p.crypto_channel import SecureChannel
+from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.serializer import MSGPackSerializer
+
+logger = get_logger(__name__)
+
+_HEADER = struct.Struct(">QB")
+
+
+class Flags(IntFlag):
+    OPEN = 1
+    DATA = 2
+    CLOSE = 4
+    RESET = 8
+    ERROR = 16
+
+
+class StreamClosedError(ConnectionError):
+    """The stream (or its connection) closed before the operation completed."""
+
+
+class RemoteError(RuntimeError):
+    """The remote handler raised an exception; carries its type name and message."""
+
+    def __init__(self, type_name: str, message: str):
+        super().__init__(f"{type_name}: {message}")
+        self.type_name = type_name
+        self.remote_message = message
+
+
+_EOF = object()
+
+
+class MuxStream:
+    """One bidirectional message stream. ``send``/``receive`` whole byte messages.
+
+    Inboxes are unbounded so the connection read loop never head-of-line-blocks on a
+    slow consumer; memory is bounded per connection (``MuxConnection.max_buffered_bytes``)
+    — exceeding it kills the whole connection rather than stalling unrelated streams.
+    """
+
+    def __init__(self, conn: "MuxConnection", stream_id: int, handler_name: str):
+        self._conn = conn
+        self.stream_id = stream_id
+        self.handler_name = handler_name
+        self._inbox: asyncio.Queue = asyncio.Queue()
+        self._recv_closed = False
+        self._send_closed = False
+        self._reset = False
+
+    @property
+    def peer_id(self):
+        return self._conn.peer_id
+
+    async def send(self, message: bytes) -> None:
+        if self._send_closed or self._reset:
+            raise StreamClosedError(f"stream {self.stream_id} is closed for sending")
+        await self._conn.send_frame(self.stream_id, Flags.DATA, message)
+
+    async def send_error(self, exc: BaseException) -> None:
+        if self._send_closed or self._reset:
+            return
+        payload = MSGPackSerializer.dumps({"type": type(exc).__name__, "message": str(exc)})
+        await self._conn.send_frame(self.stream_id, Flags.ERROR, payload)
+
+    async def close_send(self) -> None:
+        """Half-close: no more messages from this side."""
+        if not self._send_closed and not self._reset:
+            self._send_closed = True
+            try:
+                await self._conn.send_frame(self.stream_id, Flags.CLOSE, b"")
+            except (ConnectionError, StreamClosedError):
+                pass
+
+    async def reset(self) -> None:
+        if not self._reset:
+            self._reset = True
+            self._send_closed = True
+            try:
+                await self._conn.send_frame(self.stream_id, Flags.RESET, b"")
+            except (ConnectionError, StreamClosedError):
+                pass
+            self._push_eof()
+            self._conn._forget_stream(self.stream_id)
+
+    async def receive(self) -> bytes:
+        """Next message; raises StreamClosedError at end-of-stream, RemoteError if the
+        peer's handler failed."""
+        if self._recv_closed:
+            raise StreamClosedError(f"stream {self.stream_id}: receive side closed")
+        item = await self._inbox.get()
+        if isinstance(item, (bytes, bytearray)):
+            self._conn._credit_bytes(len(item))
+        if item is _EOF:
+            self._recv_closed = True
+            raise StreamClosedError(f"stream {self.stream_id} ended")
+        if isinstance(item, RemoteError):
+            self._recv_closed = True
+            raise item
+        return item
+
+    async def __aiter__(self) -> AsyncIterator[bytes]:
+        while True:
+            try:
+                yield await self.receive()
+            except StreamClosedError:
+                return
+
+    def iter_messages(self) -> AsyncIterator[bytes]:
+        return self.__aiter__()
+
+    def _push(self, item) -> None:
+        self._inbox.put_nowait(item)  # unbounded: never blocks the read loop
+
+    def _push_eof(self) -> None:
+        self._inbox.put_nowait(_EOF)
+
+
+class MuxConnection:
+    """All streams between this node and one peer, over one SecureChannel."""
+
+    def __init__(
+        self,
+        channel: SecureChannel,
+        peer_id,
+        is_initiator: bool,
+        on_inbound_stream: Callable[[MuxStream], Awaitable[None]],
+        max_buffered_bytes: int = 256 * 1024 * 1024,
+    ):
+        self._channel = channel
+        self.peer_id = peer_id
+        self._next_stream_id = 1 if is_initiator else 2
+        self._streams: Dict[int, MuxStream] = {}
+        self._on_inbound_stream = on_inbound_stream
+        self._closed = False
+        self._read_task: Optional[asyncio.Task] = None
+        self._handler_tasks: set = set()
+        self._buffered_bytes = 0
+        self._max_buffered_bytes = max_buffered_bytes
+
+    def _credit_bytes(self, nbytes: int) -> None:
+        self._buffered_bytes -= nbytes
+
+    def start(self) -> None:
+        self._read_task = asyncio.create_task(self._read_loop())
+
+    @property
+    def is_closed(self) -> bool:
+        return self._closed
+
+    async def open_stream(self, handler_name: str) -> MuxStream:
+        if self._closed:
+            raise StreamClosedError(f"connection to {self.peer_id} is closed")
+        stream_id = self._next_stream_id
+        self._next_stream_id += 2
+        stream = MuxStream(self, stream_id, handler_name)
+        self._streams[stream_id] = stream
+        await self.send_frame(stream_id, Flags.OPEN, handler_name.encode("utf-8"))
+        return stream
+
+    async def send_frame(self, stream_id: int, flags: Flags, payload: bytes) -> None:
+        if self._closed:
+            raise StreamClosedError(f"connection to {self.peer_id} is closed")
+        try:
+            await self._channel.send(_HEADER.pack(stream_id, int(flags)) + payload)
+        except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
+            await self._shutdown(e)
+            raise StreamClosedError(f"connection to {self.peer_id} lost: {e}") from e
+
+    async def _read_loop(self) -> None:
+        error: Optional[BaseException] = None
+        try:
+            while True:
+                frame = await self._channel.recv()
+                stream_id, flags = _HEADER.unpack_from(frame)
+                payload = frame[_HEADER.size :]
+                await self._dispatch(stream_id, Flags(flags), payload)
+        except (ConnectionError, OSError, asyncio.IncompleteReadError, EOFError) as e:
+            error = e
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            logger.warning(f"connection to {self.peer_id}: read loop failed with {e!r}")
+            error = e
+        finally:
+            await self._shutdown(error)
+
+    async def _dispatch(self, stream_id: int, flags: Flags, payload: bytes) -> None:
+        if flags & Flags.OPEN:
+            handler_name = payload.decode("utf-8", errors="replace")
+            stream = MuxStream(self, stream_id, handler_name)
+            self._streams[stream_id] = stream
+            task = asyncio.create_task(self._on_inbound_stream(stream))
+            self._handler_tasks.add(task)
+            task.add_done_callback(self._handler_tasks.discard)
+            return
+        stream = self._streams.get(stream_id)
+        if stream is None:
+            return  # already reset/forgotten
+        if flags & Flags.DATA:
+            self._buffered_bytes += len(payload)
+            if self._buffered_bytes > self._max_buffered_bytes:
+                logger.warning(
+                    f"connection to {self.peer_id}: buffered {self._buffered_bytes} bytes "
+                    f"exceeds cap; closing connection"
+                )
+                raise ConnectionError("per-connection buffer cap exceeded")
+            stream._push(payload)
+        if flags & Flags.ERROR:
+            try:
+                info = MSGPackSerializer.loads(payload)
+                stream._push(RemoteError(info.get("type", "RemoteError"), info.get("message", "")))
+            except Exception:
+                stream._push(RemoteError("RemoteError", "malformed error payload"))
+        if flags & (Flags.CLOSE | Flags.RESET):
+            stream._push_eof()
+            if flags & Flags.RESET:
+                # peer aborted: local side must stop sending immediately
+                stream._reset = True
+                stream._send_closed = True
+                self._forget_stream(stream_id)
+
+    def _forget_stream(self, stream_id: int) -> None:
+        self._streams.pop(stream_id, None)
+
+    async def _shutdown(self, error: Optional[BaseException]) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for stream in list(self._streams.values()):
+            stream._push_eof()  # guaranteed: queue is unbounded
+        self._streams.clear()
+        self._channel.close()
+
+    async def close(self) -> None:
+        if self._read_task is not None:
+            self._read_task.cancel()
+            try:
+                await self._read_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        await self._shutdown(None)
+        for task in list(self._handler_tasks):
+            task.cancel()
+        await self._channel.wait_closed()

@@ -1,0 +1,410 @@
+"""The P2P node: listeners, dialing, handler registry, unary + streaming RPC.
+
+Capability parity with the reference's P2P facade over the Go daemon
+(hivemind/p2p/p2p_daemon.py:42-749) — minus the subprocess: transport runs in-process
+on asyncio. One encrypted multiplexed TCP connection per peer pair carries all RPCs
+(the reference's unary-vs-stream transport split, p2p_daemon.py:565-616 vs 412-513,
+collapses into one stream mechanism; both call styles remain in the API).
+
+NAT traversal / relays are a deployment concern of the native transport daemon
+(hivemind_tpu/native, later rounds); the asyncio transport targets direct TCP.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from dataclasses import dataclass, field
+from typing import (
+    Any,
+    AsyncIterator,
+    Awaitable,
+    Callable,
+    Dict,
+    List,
+    Optional,
+    Sequence,
+    Set,
+    Type,
+    TypeVar,
+    Union,
+)
+
+from hivemind_tpu.p2p.crypto_channel import HandshakeError, handshake
+from hivemind_tpu.p2p.mux import (
+    Flags,
+    MuxConnection,
+    MuxStream,
+    RemoteError,
+    StreamClosedError,
+)
+from hivemind_tpu.p2p.peer_id import Multiaddr, PeerID
+from hivemind_tpu.utils.crypto import Ed25519PrivateKey
+from hivemind_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+TRequest = TypeVar("TRequest")
+TResponse = TypeVar("TResponse")
+
+DEFAULT_MAX_MSG_SIZE = 4 * 1024 * 1024  # parity: p2p_daemon_bindings/control.py:36-39
+
+
+class P2PError(RuntimeError):
+    pass
+
+
+class P2PHandlerError(P2PError):
+    """Raised on the client when the remote handler failed (parity: p2p_daemon.py)."""
+
+
+class PeerNotFoundError(P2PError):
+    pass
+
+
+@dataclass
+class P2PContext:
+    """Passed to every RPC handler (parity: p2p/p2p_daemon.py P2PContext)."""
+
+    handle_name: str
+    local_id: PeerID
+    remote_id: PeerID
+
+
+@dataclass
+class _Handler:
+    fn: Callable[..., Any]
+    request_type: Optional[Type]
+    stream_input: bool
+    stream_output: bool
+
+
+def _parse(message_bytes: bytes, message_type: Optional[Type]):
+    if message_type is None or message_type is bytes:
+        return message_bytes
+    message = message_type()
+    message.ParseFromString(message_bytes)
+    return message
+
+
+def _serialize(message) -> bytes:
+    if isinstance(message, (bytes, bytearray)):
+        return bytes(message)
+    return message.SerializeToString()
+
+
+class P2P:
+    """An in-process peer: listens for encrypted connections, dials peers, and routes
+    named handlers. Create with ``await P2P.create(...)``."""
+
+    def __init__(self):
+        raise RuntimeError("use `await P2P.create(...)`")
+
+    @classmethod
+    async def create(
+        cls,
+        listen_host: str = "127.0.0.1",
+        listen_port: int = 0,
+        identity: Optional[Ed25519PrivateKey] = None,
+        identity_path: Optional[str] = None,
+        announce_host: Optional[str] = None,
+        initial_peers: Sequence[Union[str, Multiaddr]] = (),
+        dial_timeout: float = 10.0,
+    ) -> "P2P":
+        self = object.__new__(cls)
+        if identity is None:
+            if identity_path is not None and os.path.exists(identity_path):
+                with open(identity_path, "rb") as f:
+                    identity = Ed25519PrivateKey.from_bytes(f.read())
+            else:
+                identity = Ed25519PrivateKey()
+                if identity_path is not None:
+                    fd = os.open(identity_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+                    with os.fdopen(fd, "wb") as f:
+                        f.write(identity.to_bytes())
+        self.identity = identity
+        self.peer_id = PeerID.from_private_key(identity)
+        self._handlers: Dict[str, _Handler] = {}
+        self._connections: Dict[PeerID, MuxConnection] = {}
+        self._dial_locks: Dict[PeerID, asyncio.Lock] = {}
+        self._peerstore: Dict[PeerID, Set[Multiaddr]] = {}
+        self._dial_timeout = dial_timeout
+        self._alive_refs = 1  # P2P.replicate parity: shared instance refcount
+        self._listen_host = listen_host
+        self._announce_host = announce_host or listen_host
+
+        self._server = await asyncio.start_server(self._on_inbound_connection, listen_host, listen_port)
+        self._listen_port = self._server.sockets[0].getsockname()[1]
+        logger.debug(f"P2P {self.peer_id} listening on {listen_host}:{self._listen_port}")
+
+        for maddr in initial_peers:
+            maddr = Multiaddr.parse(maddr) if isinstance(maddr, str) else maddr
+            try:
+                await self.connect(maddr)
+            except Exception as e:
+                logger.warning(f"could not reach initial peer {maddr}: {e}")
+        return self
+
+    # ------------------------------------------------------------------ identity
+
+    @classmethod
+    def generate_identity(cls, identity_path: str) -> None:
+        """Write a fresh Ed25519 identity file (parity: p2p_daemon.py generate_identity)."""
+        key = Ed25519PrivateKey()
+        fd = os.open(identity_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "wb") as f:
+            f.write(key.to_bytes())
+
+    async def replicate(self) -> "P2P":
+        """The reference attaches extra clients to one daemon (p2p_daemon.py:replicate);
+        in-process, components simply share this instance."""
+        self._alive_refs += 1
+        return self
+
+    def get_visible_maddrs(self, latest: bool = False) -> List[Multiaddr]:
+        return [Multiaddr(self._announce_host, self._listen_port, self.peer_id)]
+
+    @property
+    def listen_port(self) -> int:
+        return self._listen_port
+
+    # ------------------------------------------------------------------ connections
+
+    async def _on_inbound_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            channel, extras = await handshake(
+                reader, writer, self.identity, is_initiator=False,
+                announced_addrs=self.get_visible_maddrs(),
+            )
+        except (HandshakeError, asyncio.TimeoutError, asyncio.IncompleteReadError, ConnectionError, OSError) as e:
+            logger.debug(f"inbound handshake failed: {e!r}")
+            writer.close()
+            return
+        from hivemind_tpu.utils.crypto import Ed25519PublicKey
+
+        peer_id = PeerID.from_public_key(Ed25519PublicKey.from_bytes(extras["static"]))
+        self._register_peer_addrs(peer_id, extras.get("addrs", ()))
+        conn = MuxConnection(channel, peer_id, is_initiator=False, on_inbound_stream=self._route_stream)
+        existing = self._connections.get(peer_id)
+        if existing is None or existing.is_closed:
+            self._connections[peer_id] = conn  # replace stale connections with the live one
+        conn.start()
+
+    def _register_peer_addrs(self, peer_id: PeerID, addrs) -> None:
+        store = self._peerstore.setdefault(peer_id, set())
+        for addr in addrs:
+            try:
+                store.add(Multiaddr.parse(addr) if isinstance(addr, str) else addr)
+            except ValueError:
+                continue
+
+    def add_peer_addr(self, peer_id: PeerID, maddr: Union[str, Multiaddr]) -> None:
+        self._register_peer_addrs(peer_id, [maddr])
+
+    async def connect(self, maddr: Union[str, Multiaddr]) -> PeerID:
+        """Dial an address; returns the authenticated PeerID behind it."""
+        maddr = Multiaddr.parse(maddr) if isinstance(maddr, str) else maddr
+        conn = await self._dial(maddr, expected_peer=maddr.peer_id)
+        return conn.peer_id
+
+    async def _dial(self, maddr: Multiaddr, expected_peer: Optional[PeerID]) -> MuxConnection:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(maddr.host, maddr.port), timeout=self._dial_timeout
+        )
+        try:
+            channel, extras = await handshake(
+                reader, writer, self.identity, is_initiator=True,
+                announced_addrs=self.get_visible_maddrs(),
+            )
+        except BaseException:
+            writer.close()
+            raise
+        from hivemind_tpu.utils.crypto import Ed25519PublicKey
+
+        peer_id = PeerID.from_public_key(Ed25519PublicKey.from_bytes(extras["static"]))
+        if expected_peer is not None and peer_id != expected_peer:
+            channel.close()
+            raise HandshakeError(f"dialed {expected_peer} but found {peer_id}")
+        self._register_peer_addrs(peer_id, [maddr.with_peer_id(peer_id)])
+        self._register_peer_addrs(peer_id, extras.get("addrs", ()))
+        existing = self._connections.get(peer_id)
+        if existing is not None and not existing.is_closed:
+            channel.close()
+            return existing
+        conn = MuxConnection(channel, peer_id, is_initiator=True, on_inbound_stream=self._route_stream)
+        self._connections[peer_id] = conn
+        conn.start()
+        return conn
+
+    async def _get_connection(self, peer_id: PeerID) -> MuxConnection:
+        conn = self._connections.get(peer_id)
+        if conn is not None and not conn.is_closed:
+            return conn
+        lock = self._dial_locks.setdefault(peer_id, asyncio.Lock())
+        async with lock:
+            conn = self._connections.get(peer_id)
+            if conn is not None and not conn.is_closed:
+                return conn
+            last_error: Optional[Exception] = None
+            for maddr in sorted(self._peerstore.get(peer_id, ()), key=str):
+                try:
+                    return await self._dial(maddr, expected_peer=peer_id)
+                except Exception as e:
+                    last_error = e
+            raise PeerNotFoundError(f"no reachable address for {peer_id}") from last_error
+
+    # ------------------------------------------------------------------ handlers
+
+    async def add_protobuf_handler(
+        self,
+        name: str,
+        handler: Callable[..., Any],
+        request_type: Optional[Type] = None,
+        *,
+        stream_input: bool = False,
+        stream_output: bool = False,
+    ) -> None:
+        """Register a named handler. Unary: ``async fn(request, context) -> response``.
+        Stream input: request is an AsyncIterator. Stream output: fn returns/yields an
+        AsyncIterator of responses."""
+        if name in self._handlers:
+            raise P2PError(f"handler {name!r} is already registered")
+        self._handlers[name] = _Handler(handler, request_type, stream_input, stream_output)
+
+    async def remove_protobuf_handler(self, name: str) -> None:
+        self._handlers.pop(name, None)
+
+    async def _route_stream(self, stream: MuxStream) -> None:
+        handler = self._handlers.get(stream.handler_name)
+        if handler is None:
+            await stream.send_error(P2PHandlerError(f"unknown handler {stream.handler_name!r}"))
+            await stream.close_send()
+            return
+        context = P2PContext(stream.handler_name, self.peer_id, stream.peer_id)
+        try:
+            if handler.stream_input:
+                request: Any = self._parse_stream(stream, handler.request_type)
+            else:
+                request = _parse(await stream.receive(), handler.request_type)
+
+            if handler.stream_output:
+                result = handler.fn(request, context)
+                if asyncio.iscoroutine(result):
+                    result = await result
+                async for response in result:
+                    await stream.send(_serialize(response))
+            else:
+                response = await handler.fn(request, context)
+                await stream.send(_serialize(response))
+            await stream.close_send()
+        except StreamClosedError:
+            return  # peer reset/vanished mid-call: normal termination for a handler
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            logger.debug(f"handler {stream.handler_name} failed: {e!r}")
+            try:
+                await stream.send_error(e)
+                await stream.close_send()
+            except StreamClosedError:
+                pass
+
+    @staticmethod
+    async def _parse_stream(stream: MuxStream, request_type: Optional[Type]) -> AsyncIterator:
+        async for message in stream.iter_messages():
+            yield _parse(message, request_type)
+
+    # ------------------------------------------------------------------ calls
+
+    async def call_protobuf_handler(
+        self,
+        peer_id: PeerID,
+        name: str,
+        request,
+        response_type: Optional[Type] = None,
+    ):
+        """Unary call: one request, one response."""
+        conn = await self._get_connection(peer_id)
+        stream = await conn.open_stream(name)
+        try:
+            await stream.send(_serialize(request))
+            await stream.close_send()
+            try:
+                response = await stream.receive()
+            except RemoteError as e:
+                raise P2PHandlerError(str(e)) from e
+            except StreamClosedError:
+                raise P2PHandlerError(f"{name}: stream closed before response") from None
+            return _parse(response, response_type)
+        finally:
+            await stream.reset()
+
+    async def iterate_protobuf_handler(
+        self,
+        peer_id: PeerID,
+        name: str,
+        requests,
+        response_type: Optional[Type] = None,
+    ) -> AsyncIterator:
+        """Streaming call: ``requests`` is one message or an async iterator of them;
+        yields response messages until the remote closes."""
+        conn = await self._get_connection(peer_id)
+        stream = await conn.open_stream(name)
+
+        async def _feed():
+            try:
+                if hasattr(requests, "__aiter__"):
+                    async for request in requests:
+                        await stream.send(_serialize(request))
+                else:
+                    await stream.send(_serialize(requests))
+                await stream.close_send()
+            except (StreamClosedError, asyncio.CancelledError):
+                pass
+            except Exception:
+                # the caller's request iterator failed: abort so neither side hangs;
+                # the exception is re-raised to the consumer below via feeder.exception()
+                await stream.reset()
+                raise
+
+        feeder = asyncio.create_task(_feed())
+        try:
+            while True:
+                try:
+                    message = await stream.receive()
+                except StreamClosedError:
+                    if feeder.done() and not feeder.cancelled() and feeder.exception() is not None:
+                        raise feeder.exception()
+                    return
+                except RemoteError as e:
+                    raise P2PHandlerError(str(e)) from e
+                yield _parse(message, response_type)
+        finally:
+            feeder.cancel()
+            await stream.reset()
+
+    # ------------------------------------------------------------------ lifecycle
+
+    async def list_peers(self) -> List[PeerID]:
+        return [pid for pid, conn in self._connections.items() if not conn.is_closed]
+
+    async def disconnect(self, peer_id: PeerID) -> None:
+        conn = self._connections.pop(peer_id, None)
+        if conn is not None:
+            await conn.close()
+
+    async def shutdown(self) -> None:
+        self._alive_refs -= 1
+        if self._alive_refs > 0:
+            return
+        self._server.close()
+        for conn in list(self._connections.values()):
+            await conn.close()
+        self._connections.clear()
+        try:
+            await self._server.wait_closed()
+        except Exception:
+            pass
+
+    def __repr__(self):
+        return f"P2P({self.peer_id}, port={self._listen_port}, handlers={len(self._handlers)})"

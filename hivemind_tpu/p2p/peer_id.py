@@ -1,0 +1,169 @@
+"""Peer identity and addressing.
+
+Capability parity with the reference's libp2p identity surface
+(hivemind/p2p/p2p_daemon_bindings/datastructures.py:134): a PeerID is a multihash of
+the node's public key, rendered in base58. This build derives it from an Ed25519 public
+key: ``base58(0x12 0x20 || sha256(pubkey))`` (the same shape as a libp2p CIDv0).
+Addresses are a minimal multiaddr dialect: ``/ip4/<host>/tcp/<port>[/p2p/<peer_id>]``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional, Tuple
+
+from hivemind_tpu.utils.crypto import Ed25519PrivateKey, Ed25519PublicKey
+
+_B58_ALPHABET = "123456789ABCDEFGHJKLMNPQRSTUVWXYZabcdefghijkmnopqrstuvwxyz"
+_B58_INDEX = {c: i for i, c in enumerate(_B58_ALPHABET)}
+
+
+def base58_encode(data: bytes) -> str:
+    num = int.from_bytes(data, "big")
+    out = []
+    while num:
+        num, rem = divmod(num, 58)
+        out.append(_B58_ALPHABET[rem])
+    pad = 0
+    for byte in data:
+        if byte == 0:
+            pad += 1
+        else:
+            break
+    return "1" * pad + "".join(reversed(out))
+
+
+def base58_decode(text: str) -> bytes:
+    num = 0
+    for char in text:
+        try:
+            num = num * 58 + _B58_INDEX[char]
+        except KeyError:
+            raise ValueError(f"invalid base58 character {char!r}") from None
+    raw = num.to_bytes((num.bit_length() + 7) // 8, "big")
+    pad = 0
+    for char in text:
+        if char == "1":
+            pad += 1
+        else:
+            break
+    return b"\x00" * pad + raw
+
+
+_MULTIHASH_SHA256 = b"\x12\x20"  # sha2-256, 32 bytes
+
+
+class PeerID:
+    """An opaque, hashable, orderable node identity."""
+
+    __slots__ = ("_bytes", "_b58")
+
+    def __init__(self, peer_id_bytes: bytes):
+        self._bytes = bytes(peer_id_bytes)
+        self._b58 = base58_encode(self._bytes)
+
+    @classmethod
+    def from_public_key(cls, public_key: Ed25519PublicKey) -> "PeerID":
+        digest = hashlib.sha256(public_key.to_bytes()).digest()
+        return cls(_MULTIHASH_SHA256 + digest)
+
+    @classmethod
+    def from_private_key(cls, private_key: Ed25519PrivateKey) -> "PeerID":
+        return cls.from_public_key(private_key.get_public_key())
+
+    @classmethod
+    def from_base58(cls, b58: str) -> "PeerID":
+        return cls(base58_decode(b58))
+
+    def to_bytes(self) -> bytes:
+        return self._bytes
+
+    def to_base58(self) -> str:
+        return self._b58
+
+    def __repr__(self) -> str:
+        return f"<PeerID {self._b58[:12]}…>" if len(self._b58) > 12 else f"<PeerID {self._b58}>"
+
+    def __str__(self) -> str:
+        return self._b58
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, PeerID) and self._bytes == other._bytes
+
+    def __lt__(self, other: "PeerID") -> bool:
+        return self._bytes < other._bytes
+
+    def __hash__(self) -> int:
+        return hash(self._bytes)
+
+    def xor_distance(self, other: "PeerID") -> int:
+        return int.from_bytes(hashlib.sha256(self._bytes).digest(), "big") ^ int.from_bytes(
+            hashlib.sha256(other._bytes).digest(), "big"
+        )
+
+
+class Multiaddr:
+    """Minimal multiaddr: /ip4/<host>/tcp/<port>[/p2p/<peer_id>]; /dns4 accepted as host."""
+
+    __slots__ = ("host", "port", "peer_id")
+
+    def __init__(self, host: str, port: int, peer_id: Optional[PeerID] = None):
+        self.host = host
+        self.port = int(port)
+        self.peer_id = peer_id
+
+    @classmethod
+    def parse(cls, text: str) -> "Multiaddr":
+        parts = [p for p in str(text).split("/") if p]
+        host = port = None
+        peer_id = None
+        i = 0
+        while i < len(parts):
+            proto = parts[i]
+            if i + 1 >= len(parts):
+                raise ValueError(f"multiaddr {text!r}: protocol {proto!r} is missing its value")
+            value = parts[i + 1]
+            try:
+                if proto in ("ip4", "ip6", "dns4", "dns6", "dns"):
+                    host = value
+                elif proto == "tcp":
+                    port = int(value)
+                elif proto == "p2p":
+                    peer_id = PeerID.from_base58(value)
+                else:
+                    raise ValueError(f"unsupported multiaddr protocol {proto!r} in {text!r}")
+            except ValueError:
+                raise
+            except Exception as e:
+                raise ValueError(f"malformed multiaddr {text!r}: {e}") from e
+            i += 2
+        if host is None or port is None:
+            raise ValueError(f"multiaddr {text!r} must contain a host and tcp port")
+        return cls(host, port, peer_id)
+
+    def with_peer_id(self, peer_id: PeerID) -> "Multiaddr":
+        return Multiaddr(self.host, self.port, peer_id)
+
+    @property
+    def endpoint(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    def __str__(self) -> str:
+        base = f"/ip4/{self.host}/tcp/{self.port}"
+        if self.peer_id is not None:
+            base += f"/p2p/{self.peer_id.to_base58()}"
+        return base
+
+    def __repr__(self) -> str:
+        return f"Multiaddr({self})"
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Multiaddr)
+            and self.host == other.host
+            and self.port == other.port
+            and self.peer_id == other.peer_id
+        )
+
+    def __hash__(self) -> int:
+        return hash((self.host, self.port, self.peer_id))

@@ -1,0 +1,7 @@
+#!/bin/sh
+# Regenerate *_pb2.py from the .proto schemas. Run from the repo root.
+# Generated files are checked in so the package needs no build step.
+set -e
+cd "$(dirname "$0")/../.."
+protoc --python_out=. hivemind_tpu/proto/*.proto
+echo "regenerated: $(ls hivemind_tpu/proto/*_pb2.py)"

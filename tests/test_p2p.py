@@ -1,0 +1,262 @@
+"""Transport tests — scope mirrors reference tests/test_p2p_daemon.py +
+test_p2p_servicer.py: lifecycle, identity, unary/stream handlers, errors,
+cancellation, servicer reflection."""
+
+import asyncio
+from typing import AsyncIterator
+
+import pytest
+
+from hivemind_tpu.p2p import (
+    P2P,
+    Multiaddr,
+    P2PContext,
+    P2PHandlerError,
+    PeerID,
+    PeerNotFoundError,
+    ServicerBase,
+)
+from hivemind_tpu.p2p.peer_id import base58_decode, base58_encode
+from hivemind_tpu.proto import test_pb2
+
+
+def test_base58_roundtrip():
+    for data in [b"", b"\x00\x00abc", b"hello world", bytes(range(256))]:
+        assert base58_decode(base58_encode(data)) == data
+    with pytest.raises(ValueError):
+        base58_decode("0OIl")  # excluded characters
+
+
+def test_peer_id_and_multiaddr():
+    from hivemind_tpu.utils.crypto import Ed25519PrivateKey
+
+    key = Ed25519PrivateKey()
+    pid = PeerID.from_private_key(key)
+    assert PeerID.from_base58(pid.to_base58()) == pid
+    maddr = Multiaddr.parse(f"/ip4/127.0.0.1/tcp/1234/p2p/{pid.to_base58()}")
+    assert maddr.host == "127.0.0.1" and maddr.port == 1234 and maddr.peer_id == pid
+    assert Multiaddr.parse(str(maddr)) == maddr
+    with pytest.raises(ValueError):
+        Multiaddr.parse("/udp/53")
+
+
+async def test_p2p_lifecycle_and_identity(tmp_path):
+    ident = str(tmp_path / "id.key")
+    p2p = await P2P.create(identity_path=ident)
+    peer_id = p2p.peer_id
+    maddrs = p2p.get_visible_maddrs()
+    assert len(maddrs) == 1 and maddrs[0].peer_id == peer_id
+    await p2p.shutdown()
+    # identity persists across restarts
+    p2p2 = await P2P.create(identity_path=ident)
+    assert p2p2.peer_id == peer_id
+    await p2p2.shutdown()
+
+
+async def test_unary_handler_and_errors():
+    server = await P2P.create()
+    client = await P2P.create()
+
+    async def square(request: test_pb2.TestRequest, context: P2PContext) -> test_pb2.TestResponse:
+        assert context.remote_id == client.peer_id
+        return test_pb2.TestResponse(number=request.number**2)
+
+    async def fail(request: test_pb2.TestRequest, context: P2PContext) -> test_pb2.TestResponse:
+        raise ValueError("deliberate failure")
+
+    await server.add_protobuf_handler("square", square, test_pb2.TestRequest)
+    await server.add_protobuf_handler("fail", fail, test_pb2.TestRequest)
+
+    await client.connect(server.get_visible_maddrs()[0])
+    response = await client.call_protobuf_handler(
+        server.peer_id, "square", test_pb2.TestRequest(number=12), test_pb2.TestResponse
+    )
+    assert response.number == 144
+
+    with pytest.raises(P2PHandlerError, match="deliberate failure"):
+        await client.call_protobuf_handler(
+            server.peer_id, "fail", test_pb2.TestRequest(number=1), test_pb2.TestResponse
+        )
+    with pytest.raises(P2PHandlerError, match="unknown handler"):
+        await client.call_protobuf_handler(
+            server.peer_id, "nonexistent", test_pb2.TestRequest(number=1), test_pb2.TestResponse
+        )
+
+    await client.shutdown()
+    await server.shutdown()
+
+
+async def test_streaming_handler_both_directions():
+    server = await P2P.create()
+    client = await P2P.create()
+
+    async def partial_sums(
+        requests: AsyncIterator[test_pb2.TestRequest], context: P2PContext
+    ) -> AsyncIterator[test_pb2.TestResponse]:
+        total = 0
+        async for request in requests:
+            total += request.number
+            yield test_pb2.TestResponse(number=total)
+
+    await server.add_protobuf_handler(
+        "partial_sums", partial_sums, test_pb2.TestRequest, stream_input=True, stream_output=True
+    )
+    await client.connect(server.get_visible_maddrs()[0])
+
+    async def gen():
+        for i in [1, 2, 3, 4]:
+            yield test_pb2.TestRequest(number=i)
+
+    sums = [
+        r.number
+        async for r in client.iterate_protobuf_handler(
+            server.peer_id, "partial_sums", gen(), test_pb2.TestResponse
+        )
+    ]
+    assert sums == [1, 3, 6, 10]
+    await client.shutdown()
+    await server.shutdown()
+
+
+async def test_dial_failures():
+    client = await P2P.create()
+    with pytest.raises((OSError, asyncio.TimeoutError, ConnectionError)):
+        await client.connect("/ip4/127.0.0.1/tcp/1")  # nothing listening
+    with pytest.raises(PeerNotFoundError):
+        from hivemind_tpu.utils.crypto import Ed25519PrivateKey
+
+        unknown = PeerID.from_private_key(Ed25519PrivateKey())
+        await client.call_protobuf_handler(unknown, "x", b"", None)
+    await client.shutdown()
+
+
+async def test_wrong_expected_peer_rejected():
+    from hivemind_tpu.p2p.crypto_channel import HandshakeError
+    from hivemind_tpu.utils.crypto import Ed25519PrivateKey
+
+    server = await P2P.create()
+    client = await P2P.create()
+    impostor = PeerID.from_private_key(Ed25519PrivateKey())
+    bad_maddr = Multiaddr("127.0.0.1", server.listen_port, impostor)
+    with pytest.raises(HandshakeError, match="dialed"):
+        await client.connect(bad_maddr)
+    await client.shutdown()
+    await server.shutdown()
+
+
+async def test_server_streaming_cancellation():
+    server = await P2P.create()
+    client = await P2P.create()
+    served = asyncio.Event()
+    cancelled = asyncio.Event()
+
+    async def infinite(request: test_pb2.TestRequest, context: P2PContext) -> AsyncIterator[test_pb2.TestResponse]:
+        try:
+            n = 0
+            while True:
+                yield test_pb2.TestResponse(number=n)
+                n += 1
+                served.set()
+                await asyncio.sleep(0.001)
+        except (asyncio.CancelledError, ConnectionError):
+            cancelled.set()
+            raise
+
+    await server.add_protobuf_handler("infinite", infinite, test_pb2.TestRequest, stream_output=True)
+    await client.connect(server.get_visible_maddrs()[0])
+
+    iterator = client.iterate_protobuf_handler(
+        server.peer_id, "infinite", test_pb2.TestRequest(number=0), test_pb2.TestResponse
+    )
+    received = 0
+    async for _ in iterator:
+        received += 1
+        if received >= 3:
+            break  # closes the generator → resets the stream
+    assert served.is_set()
+    await client.shutdown()
+    await server.shutdown()
+
+
+async def test_large_messages():
+    server = await P2P.create()
+    client = await P2P.create()
+
+    async def echo_len(request: bytes, context: P2PContext) -> bytes:
+        return len(request).to_bytes(8, "big")
+
+    await server.add_protobuf_handler("echo_len", echo_len, bytes)
+    await client.connect(server.get_visible_maddrs()[0])
+    payload = b"x" * (3 * 1024 * 1024)  # 3 MiB through the AEAD + mux path
+    result = await client.call_protobuf_handler(server.peer_id, "echo_len", payload, bytes)
+    assert int.from_bytes(result, "big") == len(payload)
+    await client.shutdown()
+    await server.shutdown()
+
+
+class MathServicer(ServicerBase):
+    async def rpc_square(self, request: test_pb2.TestRequest, context: P2PContext) -> test_pb2.TestResponse:
+        return test_pb2.TestResponse(number=request.number**2)
+
+    async def rpc_count(self, request: test_pb2.TestRequest, context: P2PContext) -> AsyncIterator[test_pb2.TestResponse]:
+        for i in range(request.number):
+            yield test_pb2.TestResponse(number=i)
+
+    async def rpc_sum(self, requests: AsyncIterator[test_pb2.TestRequest], context: P2PContext) -> test_pb2.TestResponse:
+        total = 0
+        async for request in requests:
+            total += request.number
+        return test_pb2.TestResponse(number=total)
+
+    async def rpc_slow_count(self, request: test_pb2.TestRequest, context: P2PContext) -> AsyncIterator[test_pb2.TestResponse]:
+        for i in range(request.number):
+            await asyncio.sleep(5)
+            yield test_pb2.TestResponse(number=i)
+
+
+async def test_servicer_reflection():
+    specs = {s.method_name: s for s in MathServicer._collect_rpc_specs()}
+    assert not specs["rpc_square"].stream_input and not specs["rpc_square"].stream_output
+    assert not specs["rpc_count"].stream_input and specs["rpc_count"].stream_output
+    assert specs["rpc_sum"].stream_input and not specs["rpc_sum"].stream_output
+    assert specs["rpc_slow_count"].stream_output
+
+    server = await P2P.create()
+    client = await P2P.create()
+    servicer = MathServicer()
+    await servicer.add_p2p_handlers(server)
+    await client.connect(server.get_visible_maddrs()[0])
+
+    stub = MathServicer.get_stub(client, server.peer_id)
+    assert (await stub.rpc_square(test_pb2.TestRequest(number=9))).number == 81
+    counted = [r.number async for r in stub.rpc_count(test_pb2.TestRequest(number=4))]
+    assert counted == [0, 1, 2, 3]
+
+    async def gen():
+        for i in range(5):
+            yield test_pb2.TestRequest(number=i)
+
+    assert (await stub.rpc_sum(gen())).number == 10
+
+    with pytest.raises(asyncio.TimeoutError):
+        async for _ in stub.rpc_slow_count(test_pb2.TestRequest(number=1), timeout=0.1):
+            pass
+
+    await client.shutdown()
+    await server.shutdown()
+
+
+async def test_servicer_namespaces():
+    server = await P2P.create()
+    client = await P2P.create()
+    servicer_a, servicer_b = MathServicer(), MathServicer()
+    await servicer_a.add_p2p_handlers(server, namespace="a")
+    await servicer_b.add_p2p_handlers(server, namespace="b")
+    await client.connect(server.get_visible_maddrs()[0])
+    stub_a = MathServicer.get_stub(client, server.peer_id, namespace="a")
+    assert (await stub_a.rpc_square(test_pb2.TestRequest(number=3))).number == 9
+    stub_missing = MathServicer.get_stub(client, server.peer_id, namespace="missing")
+    with pytest.raises(P2PHandlerError):
+        await stub_missing.rpc_square(test_pb2.TestRequest(number=3))
+    await client.shutdown()
+    await server.shutdown()
