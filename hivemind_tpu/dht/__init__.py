@@ -1,0 +1,8 @@
+from hivemind_tpu.dht.crypto import Ed25519SignatureValidator
+from hivemind_tpu.dht.dht import DHT
+from hivemind_tpu.dht.node import Blacklist, DHTNode
+from hivemind_tpu.dht.protocol import DHTProtocol
+from hivemind_tpu.dht.routing import DHTID, DHTKey, PeerInfo, RoutingTable, Subkey
+from hivemind_tpu.dht.schema import BytesWithEd25519PublicKey, SchemaValidator
+from hivemind_tpu.dht.storage import DHTLocalStorage, DictionaryDHTValue
+from hivemind_tpu.dht.validation import CompositeValidator, DHTRecord, RecordValidatorBase

@@ -1,0 +1,107 @@
+"""Schema-validated DHT records via pydantic v2 models (capability parity: reference
+hivemind/dht/schema.py:15-155, which uses pydantic v1; this build is v2-native).
+
+Each field of the schema model describes one DHT key: the field's type constrains the
+values (and, for dict-typed fields, the subkey and value types). Keys not covered by
+any schema are accepted or rejected according to ``allow_extra_keys``.
+"""
+
+from __future__ import annotations
+
+import re
+import typing
+from typing import Any, Dict, Optional, Type
+
+import pydantic
+
+from hivemind_tpu.dht.routing import DHTID
+from hivemind_tpu.dht.validation import DHTRecord, RecordValidatorBase
+from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.serializer import MSGPackSerializer
+
+logger = get_logger(__name__)
+
+
+class SchemaValidator(RecordValidatorBase):
+    def __init__(
+        self,
+        schema: Type[pydantic.BaseModel],
+        allow_extra_keys: bool = True,
+        prefix: Optional[str] = None,
+    ):
+        self._patterns_to_models: Dict[re.Pattern, tuple] = {}
+        self._allow_extra_keys = allow_extra_keys
+        self._add_schema(schema, prefix)
+
+    def _add_schema(self, schema: Type[pydantic.BaseModel], prefix: Optional[str]) -> None:
+        for field_name, field_info in schema.model_fields.items():
+            key_name = f"{prefix}_{field_name}" if prefix is not None else field_name
+            key_id = DHTID.generate(source=key_name).to_bytes()
+            annotation = field_info.annotation
+            is_dict = typing.get_origin(annotation) in (dict, Dict)
+            # a single-field model for validating one record's value
+            field_model = pydantic.create_model(
+                f"_Field_{key_name}",
+                __config__=pydantic.ConfigDict(strict=False, arbitrary_types_allowed=True),
+                value=(annotation, ...),
+            )
+            # a protected key may carry an [owner:…] suffix appended to the hashed id
+            # (reference schema.py allows the same optional public-key tail)
+            pattern = re.compile(re.escape(key_id.hex()) + r"(.*)?")
+            self._patterns_to_models[pattern] = (field_model, is_dict, key_name)
+
+    def validate(self, record: DHTRecord) -> bool:
+        models = [
+            (model, is_dict, name)
+            for pattern, (model, is_dict, name) in self._patterns_to_models.items()
+            if pattern.fullmatch(record.key.hex())
+        ]
+        if not models:
+            if not self._allow_extra_keys:
+                logger.debug(f"record key {record.key.hex()[:16]}… matches no schema")
+            return self._allow_extra_keys
+        try:
+            value = MSGPackSerializer.loads(record.value)
+        except Exception:
+            logger.debug("schema validation: value is not valid msgpack")
+            return False
+        for model, is_dict, name in models:
+            try:
+                if is_dict and record.subkey:
+                    subkey = MSGPackSerializer.loads(record.subkey)
+                    model(value={subkey: value})
+                else:
+                    model(value=value)
+                return True
+            except pydantic.ValidationError as e:
+                logger.debug(f"schema validation failed for key {name}: {e}")
+        return False
+
+    @property
+    def priority(self) -> int:
+        return 1  # runs beneath signature validators (on already-stripped values)
+
+    def merge_with(self, other: RecordValidatorBase) -> bool:
+        if not isinstance(other, SchemaValidator):
+            return False
+        self._patterns_to_models.update(other._patterns_to_models)
+        self._allow_extra_keys = self._allow_extra_keys or other._allow_extra_keys
+        return True
+
+
+def conbytes(*, regex: Optional[bytes] = None) -> Any:
+    """A bytes type constrained by a regex (parity helper for schemas like
+    BytesWithPublicKey, reference schema.py:179)."""
+    pattern = re.compile(regex) if regex is not None else None
+
+    def _validate(value: Any) -> bytes:
+        if not isinstance(value, bytes):
+            raise ValueError(f"expected bytes, got {type(value)}")
+        if pattern is not None and not pattern.fullmatch(value):
+            raise ValueError("bytes do not match the required pattern")
+        return value
+
+    return typing.Annotated[bytes, pydantic.BeforeValidator(_validate)]
+
+
+BytesWithEd25519PublicKey = conbytes(regex=rb".*\[owner:.+\].*")

@@ -59,12 +59,14 @@ class SecureChannel:
         self._send_lock = asyncio.Lock()
 
     async def send(self, payload: bytes) -> None:
+        # size check BEFORE the counter moves: raising after an increment would
+        # desynchronize AEAD nonces and poison the whole connection
+        if len(payload) + 16 > MAX_FRAME_SIZE:  # +16: poly1305 tag
+            raise ValueError(f"frame too large: {len(payload)} > {MAX_FRAME_SIZE - 16}")
         async with self._send_lock:
             nonce = struct.pack("<4xQ", self._send_counter)
             self._send_counter += 1
             ciphertext = self._send_aead.encrypt(nonce, payload, None)
-            if len(ciphertext) > MAX_FRAME_SIZE:
-                raise ValueError(f"frame too large: {len(ciphertext)} > {MAX_FRAME_SIZE}")
             header = struct.pack(">I", len(ciphertext))
             self._writer.write(header + ciphertext)
             await self._writer.drain()
@@ -124,19 +126,24 @@ async def handshake(
         eph_pub = ephemeral.public_key().public_bytes(
             serialization.Encoding.Raw, serialization.PublicFormat.Raw
         )
+        # the signature covers the ENTIRE hello payload (not just the ephemeral), so a
+        # MITM cannot rewrite the announced addresses without failing verification
+        static_pub = identity.get_public_key().to_bytes()
+        addrs = [str(a) for a in (announced_addrs or [])]
+        signed_payload = MSGPackSerializer.dumps([static_pub, eph_pub, addrs, 1])
         hello = {
-            "static": identity.get_public_key().to_bytes(),
-            "ephemeral": eph_pub,
-            "sig": identity.sign(_HANDSHAKE_PREFIX + eph_pub),
-            "addrs": [str(a) for a in (announced_addrs or [])],
-            "version": 1,
+            "payload": signed_payload,
+            "sig": identity.sign(_HANDSHAKE_PREFIX + signed_payload),
         }
         await _send_plain(writer, MSGPackSerializer.dumps(hello))
-        peer_hello = MSGPackSerializer.loads(await _recv_plain(reader))
+        peer_hello_outer = MSGPackSerializer.loads(await _recv_plain(reader))
 
-        peer_static = Ed25519PublicKey.from_bytes(peer_hello["static"])
-        if not peer_static.verify(_HANDSHAKE_PREFIX + peer_hello["ephemeral"], peer_hello["sig"]):
+        peer_payload = peer_hello_outer["payload"]
+        peer_static_bytes, peer_eph_bytes, peer_addrs, peer_version = MSGPackSerializer.loads(peer_payload)
+        peer_static = Ed25519PublicKey.from_bytes(peer_static_bytes)
+        if not peer_static.verify(_HANDSHAKE_PREFIX + peer_payload, peer_hello_outer["sig"]):
             raise HandshakeError("peer failed static key proof")
+        peer_hello = {"static": peer_static_bytes, "ephemeral": peer_eph_bytes, "addrs": peer_addrs}
 
         peer_eph = X25519PublicKey.from_public_bytes(peer_hello["ephemeral"])
         shared = ephemeral.exchange(peer_eph)

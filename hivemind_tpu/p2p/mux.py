@@ -30,6 +30,10 @@ logger = get_logger(__name__)
 
 _HEADER = struct.Struct(">QB")
 
+# one RPC message per frame; larger payloads must be chunked by the caller
+# (parity: reference DEFAULT_MAX_MSG_SIZE, p2p_daemon_bindings/control.py:36-39)
+MAX_MESSAGE_SIZE = 4 * 1024 * 1024
+
 
 class Flags(IntFlag):
     OPEN = 1
@@ -71,6 +75,7 @@ class MuxStream:
         self._recv_closed = False
         self._send_closed = False
         self._reset = False
+        self._inbox_bytes = 0  # bytes currently debited against the connection cap
 
     @property
     def peer_id(self):
@@ -79,6 +84,11 @@ class MuxStream:
     async def send(self, message: bytes) -> None:
         if self._send_closed or self._reset:
             raise StreamClosedError(f"stream {self.stream_id} is closed for sending")
+        if len(message) > MAX_MESSAGE_SIZE:
+            raise ValueError(
+                f"message of {len(message)} bytes exceeds MAX_MESSAGE_SIZE={MAX_MESSAGE_SIZE}; "
+                f"split large tensors with utils.streaming.split_for_streaming"
+            )
         await self._conn.send_frame(self.stream_id, Flags.DATA, message)
 
     async def send_error(self, exc: BaseException) -> None:
@@ -113,7 +123,8 @@ class MuxStream:
         if self._recv_closed:
             raise StreamClosedError(f"stream {self.stream_id}: receive side closed")
         item = await self._inbox.get()
-        if isinstance(item, (bytes, bytearray)):
+        if isinstance(item, (bytes, bytearray)) and self._inbox_bytes > 0:
+            self._inbox_bytes -= len(item)
             self._conn._credit_bytes(len(item))
         if item is _EOF:
             self._recv_closed = True
@@ -134,10 +145,18 @@ class MuxStream:
         return self.__aiter__()
 
     def _push(self, item) -> None:
+        if isinstance(item, (bytes, bytearray)):
+            self._inbox_bytes += len(item)
         self._inbox.put_nowait(item)  # unbounded: never blocks the read loop
 
     def _push_eof(self) -> None:
         self._inbox.put_nowait(_EOF)
+
+    def _return_credit(self) -> None:
+        """Credit back all undrained inbox bytes (stream reset/forgotten)."""
+        if self._inbox_bytes > 0:
+            self._conn._credit_bytes(self._inbox_bytes)
+            self._inbox_bytes = 0
 
 
 class MuxConnection:
@@ -245,7 +264,9 @@ class MuxConnection:
                 self._forget_stream(stream_id)
 
     def _forget_stream(self, stream_id: int) -> None:
-        self._streams.pop(stream_id, None)
+        stream = self._streams.pop(stream_id, None)
+        if stream is not None:
+            stream._return_credit()
 
     async def _shutdown(self, error: Optional[BaseException]) -> None:
         if self._closed:
@@ -253,6 +274,7 @@ class MuxConnection:
         self._closed = True
         for stream in list(self._streams.values()):
             stream._push_eof()  # guaranteed: queue is unbounded
+            stream._return_credit()
         self._streams.clear()
         self._channel.close()
 

@@ -47,7 +47,7 @@ logger = get_logger(__name__)
 TRequest = TypeVar("TRequest")
 TResponse = TypeVar("TResponse")
 
-DEFAULT_MAX_MSG_SIZE = 4 * 1024 * 1024  # parity: p2p_daemon_bindings/control.py:36-39
+from hivemind_tpu.p2p.mux import MAX_MESSAGE_SIZE as DEFAULT_MAX_MSG_SIZE  # enforced in MuxStream.send
 
 
 class P2PError(RuntimeError):
@@ -126,6 +126,7 @@ class P2P:
         self.peer_id = PeerID.from_private_key(identity)
         self._handlers: Dict[str, _Handler] = {}
         self._connections: Dict[PeerID, MuxConnection] = {}
+        self._all_connections: Set[MuxConnection] = set()  # incl. duplicate-race losers
         self._dial_locks: Dict[PeerID, asyncio.Lock] = {}
         self._peerstore: Dict[PeerID, Set[Multiaddr]] = {}
         self._dial_timeout = dial_timeout
@@ -188,6 +189,9 @@ class P2P:
         existing = self._connections.get(peer_id)
         if existing is None or existing.is_closed:
             self._connections[peer_id] = conn  # replace stale connections with the live one
+        # duplicate-race losers still serve the dialer's streams, and must be tracked
+        # so shutdown() can close them
+        self._all_connections.add(conn)
         conn.start()
 
     def _register_peer_addrs(self, peer_id: PeerID, addrs) -> None:
@@ -233,6 +237,7 @@ class P2P:
             return existing
         conn = MuxConnection(channel, peer_id, is_initiator=True, on_inbound_stream=self._route_stream)
         self._connections[peer_id] = conn
+        self._all_connections.add(conn)
         conn.start()
         return conn
 
@@ -398,8 +403,9 @@ class P2P:
         if self._alive_refs > 0:
             return
         self._server.close()
-        for conn in list(self._connections.values()):
+        for conn in list(self._all_connections):
             await conn.close()
+        self._all_connections.clear()
         self._connections.clear()
         try:
             await self._server.wait_closed()

@@ -103,20 +103,23 @@ class PeerID:
 
 
 class Multiaddr:
-    """Minimal multiaddr: /ip4/<host>/tcp/<port>[/p2p/<peer_id>]; /dns4 accepted as host."""
+    """Minimal multiaddr: /<host_proto>/<host>/tcp/<port>[/p2p/<peer_id>] with
+    host_proto one of ip4/ip6/dns/dns4/dns6."""
 
-    __slots__ = ("host", "port", "peer_id")
+    __slots__ = ("host", "port", "peer_id", "host_proto")
 
-    def __init__(self, host: str, port: int, peer_id: Optional[PeerID] = None):
+    def __init__(self, host: str, port: int, peer_id: Optional[PeerID] = None, host_proto: str = "ip4"):
         self.host = host
         self.port = int(port)
         self.peer_id = peer_id
+        self.host_proto = host_proto
 
     @classmethod
     def parse(cls, text: str) -> "Multiaddr":
         parts = [p for p in str(text).split("/") if p]
         host = port = None
         peer_id = None
+        host_proto = "ip4"
         i = 0
         while i < len(parts):
             proto = parts[i]
@@ -125,7 +128,7 @@ class Multiaddr:
             value = parts[i + 1]
             try:
                 if proto in ("ip4", "ip6", "dns4", "dns6", "dns"):
-                    host = value
+                    host, host_proto = value, proto
                 elif proto == "tcp":
                     port = int(value)
                 elif proto == "p2p":
@@ -139,17 +142,17 @@ class Multiaddr:
             i += 2
         if host is None or port is None:
             raise ValueError(f"multiaddr {text!r} must contain a host and tcp port")
-        return cls(host, port, peer_id)
+        return cls(host, port, peer_id, host_proto)
 
     def with_peer_id(self, peer_id: PeerID) -> "Multiaddr":
-        return Multiaddr(self.host, self.port, peer_id)
+        return Multiaddr(self.host, self.port, peer_id, self.host_proto)
 
     @property
     def endpoint(self) -> Tuple[str, int]:
         return (self.host, self.port)
 
     def __str__(self) -> str:
-        base = f"/ip4/{self.host}/tcp/{self.port}"
+        base = f"/{self.host_proto}/{self.host}/tcp/{self.port}"
         if self.peer_id is not None:
             base += f"/p2p/{self.peer_id.to_base58()}"
         return base

@@ -26,20 +26,19 @@ class _RPCSpec:
     stream_output: bool
 
 
+import collections.abc
+
+
 def _unwrap_iterator(annotation) -> tuple[Any, bool]:
+    """(inner_type, True) for AsyncIterator/Iterable/Generator annotations, else
+    (annotation, False). typing.get_origin resolves typing aliases to collections.abc."""
     origin = typing.get_origin(annotation)
-    if origin is not None and origin in (
-        typing.AsyncIterator,
-        typing.AsyncIterable,
-        typing.get_origin(AsyncIterator[int]),
+    if origin in (
+        collections.abc.AsyncIterator,
+        collections.abc.AsyncIterable,
+        collections.abc.AsyncGenerator,
     ):
         return typing.get_args(annotation)[0], True
-    # typing.AsyncIterator's origin is collections.abc.AsyncIterator
-    import collections.abc
-
-    if origin in (collections.abc.AsyncIterator, collections.abc.AsyncIterable, collections.abc.AsyncGenerator):
-        args = typing.get_args(annotation)
-        return args[0], True
     return annotation, False
 
 

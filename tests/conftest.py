@@ -4,10 +4,20 @@ path; see __graft_entry__.py). Must set env BEFORE jax import."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Force the CPU backend via jax.config, NOT the env var: this image's sitecustomize
+# registers a TPU-tunnel plugin at interpreter startup and force-sets
+# jax_platforms="axon,cpu", which would make every test run claim the real TPU chip
+# (and hang whenever the tunnel is busy/wedged). Tests must be hermetic: an 8-device
+# virtual CPU mesh. Initializing the backend here also makes the suite immune to a
+# separate cryptography-keygen/plugin-discovery deadlock observed on this image.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.devices()
 
 import asyncio  # noqa: E402
 import gc  # noqa: E402

@@ -1,0 +1,178 @@
+"""Tests for the DHT facade, record validators (signature/schema/composite), and the
+100-peer-scale behavior (scope: reference tests/test_dht.py, test_dht_crypto.py,
+test_dht_schema.py, test_dht_validation.py)."""
+
+import asyncio
+import time
+from typing import Dict
+
+import pydantic
+import pytest
+
+from hivemind_tpu.dht import (
+    DHT,
+    BytesWithEd25519PublicKey,
+    CompositeValidator,
+    DHTRecord,
+    Ed25519SignatureValidator,
+    SchemaValidator,
+)
+from hivemind_tpu.dht.routing import DHTID
+from hivemind_tpu.utils.crypto import Ed25519PrivateKey
+from hivemind_tpu.utils.serializer import MSGPackSerializer
+from hivemind_tpu.utils.timed_storage import get_dht_time
+
+
+# ---------------------------------------------------------------- validators (unit)
+
+
+def make_record(key=b"key", subkey=b"", value=b"value", expiration=None):
+    return DHTRecord(key, subkey, value, expiration or get_dht_time() + 30)
+
+
+def test_signature_validator_roundtrip():
+    alice = Ed25519SignatureValidator(Ed25519PrivateKey())
+    bob = Ed25519SignatureValidator(Ed25519PrivateKey())
+
+    # unprotected records pass through untouched
+    plain = make_record()
+    assert alice.validate(plain)
+    assert alice.sign_value(plain) == plain.value
+
+    # protected record: only the owner's signature validates
+    protected_key = b"some_key_" + alice.local_public_key
+    record = make_record(key=protected_key, value=MSGPackSerializer.dumps("payload"))
+    signed_value = alice.sign_value(record)
+    assert b"[signature:" in signed_value
+    signed_record = DHTRecord(record.key, record.subkey, signed_value, record.expiration_time)
+    assert alice.validate(signed_record)
+    assert bob.validate(signed_record)  # bob verifies using the owner key in the record
+    assert alice.strip_value(signed_record) == record.value
+
+    # tampered value must fail
+    tampered = DHTRecord(record.key, record.subkey, signed_value.replace(b"payload", b"hacked!"), record.expiration_time)
+    assert not alice.validate(tampered)
+
+    # bob cannot forge a record owned by alice
+    forged = DHTRecord(record.key, record.subkey, bob.sign_value(record), record.expiration_time)
+    assert not alice.validate(forged)
+    # protected record without any signature fails
+    assert not alice.validate(record)
+
+
+def test_signature_validator_subkey_protection():
+    alice = Ed25519SignatureValidator(Ed25519PrivateKey())
+    record = make_record(key=b"shared_dict", subkey=b"peer_" + alice.local_public_key,
+                         value=MSGPackSerializer.dumps(123))
+    signed = DHTRecord(record.key, record.subkey, alice.sign_value(record), record.expiration_time)
+    assert alice.validate(signed)
+
+
+class ProgressSchema(pydantic.BaseModel):
+    epoch: int
+    peer_progress: Dict[bytes, float]
+
+
+def test_schema_validator():
+    validator = SchemaValidator(ProgressSchema, allow_extra_keys=False)
+    epoch_key = DHTID.generate(source="epoch").to_bytes()
+
+    good = DHTRecord(epoch_key, b"", MSGPackSerializer.dumps(7), get_dht_time() + 30)
+    assert validator.validate(good)
+    bad_type = DHTRecord(epoch_key, b"", MSGPackSerializer.dumps("not an int"), get_dht_time() + 30)
+    assert not validator.validate(bad_type)
+    unknown = DHTRecord(DHTID.generate(source="spam").to_bytes(), b"", MSGPackSerializer.dumps(1), get_dht_time() + 30)
+    assert not validator.validate(unknown)  # allow_extra_keys=False
+
+    # dict field validates (subkey, value) pairs
+    progress_key = DHTID.generate(source="peer_progress").to_bytes()
+    good_sub = DHTRecord(progress_key, MSGPackSerializer.dumps(b"peer1"), MSGPackSerializer.dumps(0.5), get_dht_time() + 30)
+    assert validator.validate(good_sub)
+    bad_sub = DHTRecord(progress_key, MSGPackSerializer.dumps(b"peer1"), MSGPackSerializer.dumps("x"), get_dht_time() + 30)
+    assert not validator.validate(bad_sub)
+
+
+def test_schema_validator_merge():
+    class SchemaA(pydantic.BaseModel):
+        alpha: int
+
+    class SchemaB(pydantic.BaseModel):
+        beta: str
+
+    v = SchemaValidator(SchemaA, allow_extra_keys=False)
+    assert v.merge_with(SchemaValidator(SchemaB, allow_extra_keys=False))
+    a_key = DHTID.generate(source="alpha").to_bytes()
+    b_key = DHTID.generate(source="beta").to_bytes()
+    assert v.validate(DHTRecord(a_key, b"", MSGPackSerializer.dumps(1), get_dht_time() + 30))
+    assert v.validate(DHTRecord(b_key, b"", MSGPackSerializer.dumps("s"), get_dht_time() + 30))
+
+
+def test_composite_validator_ordering():
+    class ExpectsStripped(pydantic.BaseModel):
+        guarded: int
+
+    signature = Ed25519SignatureValidator(Ed25519PrivateKey())
+    schema = SchemaValidator(ExpectsStripped, allow_extra_keys=False)
+    composite = CompositeValidator([schema, signature])
+
+    key = DHTID.generate(source="guarded").to_bytes() + signature.local_public_key
+    record = DHTRecord(key, b"", MSGPackSerializer.dumps(42), get_dht_time() + 30)
+    signed_value = composite.sign_value(record)
+    signed = DHTRecord(key, b"", signed_value, record.expiration_time)
+    # composite must strip the signature before the schema sees the value
+    assert composite.validate(signed)
+    assert composite.strip_value(signed) == record.value
+
+
+# ---------------------------------------------------------------- DHT facade
+
+
+def test_dht_facade_sync_api():
+    alice = DHT(start=True)
+    bob = DHT(initial_peers=[str(m) for m in alice.get_visible_maddrs()], start=True)
+    try:
+        assert bob.store("question", "the answer", get_dht_time() + 60)
+        result = alice.get("question")
+        assert result.value == "the answer"
+        # return_future mode
+        future = alice.get("question", return_future=True)
+        assert future.result(timeout=10).value == "the answer"
+        # run_coroutine runs on the loop with node access
+        async def count_table(dht, node):
+            return len(node.protocol.routing_table)
+
+        assert alice.run_coroutine(count_table) >= 1
+        assert str(alice.peer_id) == str(alice.node.peer_id)
+    finally:
+        bob.shutdown()
+        alice.shutdown()
+
+
+def test_dht_facade_validators_end_to_end():
+    validator = Ed25519SignatureValidator(Ed25519PrivateKey())
+    intruder_key = Ed25519PrivateKey()
+    alice = DHT(start=True, record_validators=[validator])
+    bob = DHT(
+        initial_peers=[str(m) for m in alice.get_visible_maddrs()],
+        start=True,
+        record_validators=[Ed25519SignatureValidator(intruder_key)],
+    )
+    try:
+        # protection lives in the subkey (keys are hashed, so markers there are lost):
+        # records under alice's subkey can only be written by alice
+        owned_subkey = validator.local_public_key
+        assert alice.store("progress", 1337, get_dht_time() + 60, subkey=owned_subkey)
+        stored = bob.store("progress", 666, get_dht_time() + 120, subkey=owned_subkey)
+        assert not stored  # forgery rejected by every storing node
+        result = alice.get("progress", latest=True)
+        assert result is not None and result.value[owned_subkey].value == 1337
+    finally:
+        bob.shutdown()
+        alice.shutdown()
+
+
+def test_dht_context_manager():
+    with DHT() as dht:
+        assert dht.is_alive
+        assert dht.store("k", "v", get_dht_time() + 10)
+    assert not dht.is_alive

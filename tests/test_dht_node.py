@@ -1,0 +1,216 @@
+"""Integration tests: DHTProtocol RPCs against live nodes, DHTNode swarm store/get,
+caching, blacklist (scope: reference tests/test_dht_protocol.py + test_dht_node.py).
+All swarms are real localhost TCP — no fake network."""
+
+import asyncio
+import random
+
+import pytest
+
+from hivemind_tpu.dht.node import DHTNode
+from hivemind_tpu.dht.protocol import DHTProtocol
+from hivemind_tpu.dht.routing import DHTID
+from hivemind_tpu.dht.storage import DictionaryDHTValue
+from hivemind_tpu.p2p import P2P
+from hivemind_tpu.utils.serializer import MSGPackSerializer
+from hivemind_tpu.utils.timed_storage import get_dht_time
+
+
+async def make_protocol_pair():
+    p2p_a, p2p_b = await P2P.create(), await P2P.create()
+    proto_a = await DHTProtocol.create(p2p_a, DHTID.generate(), bucket_size=20, cache_size=100, client_mode=False)
+    proto_b = await DHTProtocol.create(p2p_b, DHTID.generate(), bucket_size=20, cache_size=100, client_mode=False)
+    await p2p_a.connect(p2p_b.get_visible_maddrs()[0])
+    return (p2p_a, proto_a), (p2p_b, proto_b)
+
+
+async def test_protocol_ping_store_find():
+    (p2p_a, proto_a), (p2p_b, proto_b) = await make_protocol_pair()
+    try:
+        # ping registers both directions
+        peer_node_id = await proto_a.call_ping(p2p_b.peer_id)
+        assert peer_node_id == proto_b.node_id
+        assert proto_b.node_id in proto_a.routing_table
+        assert proto_a.node_id in proto_b.routing_table
+
+        # plain store + find
+        key_id = DHTID.generate(source=b"key")
+        now = get_dht_time()
+        ok = await proto_a.call_store(p2p_b.peer_id, [key_id], [b"value"], now + 30)
+        assert ok == [True]
+        found = await proto_a.call_find(p2p_b.peer_id, [key_id])
+        value, nearest = found[key_id]
+        assert value.value == b"value" and abs(value.expiration_time - (now + 30)) < 1e-6
+
+        # stale store rejected
+        ok = await proto_a.call_store(p2p_b.peer_id, [key_id], [b"stale"], now + 10)
+        assert ok == [False]
+
+        # subkey (dictionary) store + find
+        dict_key = DHTID.generate(source=b"dict")
+        ok = await proto_a.call_store(
+            p2p_b.peer_id, [dict_key], [b"v1"], now + 30, subkeys=["sub1"]
+        )
+        assert ok == [True]
+        ok = await proto_a.call_store(
+            p2p_b.peer_id, [dict_key], [b"v2"], now + 40, subkeys=["sub2"]
+        )
+        assert ok == [True]
+        found = await proto_a.call_find(p2p_b.peer_id, [dict_key])
+        value, _ = found[dict_key]
+        assert isinstance(value.value, DictionaryDHTValue)
+        assert value.value.get("sub1").value == b"v1"
+        assert value.value.get("sub2").value == b"v2"
+
+        # find for a missing key: no value; nearest excludes the requester itself,
+        # so in a 2-node swarm the neighbor list is empty
+        missing = DHTID.generate()
+        found = await proto_a.call_find(p2p_b.peer_id, [missing])
+        value, nearest = found[missing]
+        assert value is None and proto_a.node_id not in nearest
+    finally:
+        for proto, p2p in ((proto_a, p2p_a), (proto_b, p2p_b)):
+            await proto.shutdown()
+            await p2p.shutdown()
+
+
+async def test_protocol_unreachable_peer():
+    p2p = await P2P.create()
+    proto = await DHTProtocol.create(p2p, DHTID.generate(), 20, 100, client_mode=False)
+    try:
+        from hivemind_tpu.utils.crypto import Ed25519PrivateKey
+        from hivemind_tpu.p2p import PeerID
+
+        ghost = PeerID.from_private_key(Ed25519PrivateKey())
+        assert await proto.call_ping(ghost) is None
+        assert await proto.call_store(ghost, [DHTID.generate()], [b"x"], get_dht_time() + 9) is None
+        assert await proto.call_find(ghost, [DHTID.generate()]) is None
+    finally:
+        await proto.shutdown()
+        await p2p.shutdown()
+
+
+async def launch_swarm(n_peers: int, **kwargs):
+    """A real localhost swarm of DHTNodes, each bootstrapping off the first."""
+    nodes = [await DHTNode.create(**kwargs)]
+    first_maddrs = await nodes[0].get_visible_maddrs()
+    rest = await asyncio.gather(
+        *(DHTNode.create(initial_peers=[str(m) for m in first_maddrs], **kwargs) for _ in range(n_peers - 1))
+    )
+    nodes.extend(rest)
+    return nodes
+
+
+async def shutdown_swarm(nodes):
+    await asyncio.gather(*(node.shutdown() for node in nodes))
+
+
+async def test_dht_node_swarm_store_get():
+    nodes = await launch_swarm(8)
+    try:
+        now = get_dht_time()
+        # store via one node, get via another
+        assert await nodes[1].store("communism", "ok", now + 60)
+        result = await nodes[-1].get("communism")
+        assert result is not None and result.value == "ok"
+
+        # complex values survive serialization
+        payload = {"tensors": [1, 2, 3], "meta": ("tuple", b"bytes")}
+        assert await nodes[2].store("payload", payload, now + 60)
+        result = await nodes[5].get("payload")
+        assert result.value == payload
+
+        # missing key
+        assert await nodes[3].get("no_such_key") is None
+
+        # freshest value wins with latest=True
+        assert await nodes[0].store("versioned", "old", now + 30)
+        assert await nodes[4].store("versioned", "new", now + 50)
+        result = await nodes[6].get("versioned", latest=True)
+        assert result.value == "new"
+    finally:
+        await shutdown_swarm(nodes)
+
+
+async def test_dht_node_subkeys_across_swarm():
+    nodes = await launch_swarm(6)
+    try:
+        now = get_dht_time()
+        assert await nodes[0].store("grid", value=b"expert0", expiration_time=now + 60, subkey="e0")
+        assert await nodes[2].store("grid", value=b"expert1", expiration_time=now + 61, subkey="e1")
+        result = await nodes[4].get("grid", latest=True)
+        assert isinstance(result.value, dict)
+        assert result.value["e0"].value == b"expert0"
+        assert result.value["e1"].value == b"expert1"
+    finally:
+        await shutdown_swarm(nodes)
+
+
+async def test_dht_node_caching():
+    # num_replicas=1 so most nodes do NOT hold the value in storage and must cache it
+    nodes = await launch_swarm(5, cache_refresh_before_expiry=0, num_replicas=1)
+    try:
+        now = get_dht_time()
+        await nodes[0].store("hot_key", 42, now + 60)
+        key_id = DHTID.generate(source="hot_key")
+        reader = next(n for n in nodes if n.protocol.storage.get(key_id) is None)
+        result = await reader.get("hot_key")
+        assert result.value == 42
+        # second read must be servable from the local cache
+        assert reader.protocol.cache.get(key_id) is not None
+    finally:
+        await shutdown_swarm(nodes)
+
+
+async def test_dht_node_blacklist_and_recovery():
+    nodes = await launch_swarm(4)
+    try:
+        victim = nodes[2]
+        victim_peer = victim.peer_id
+        await victim.shutdown()
+        # trigger failures so survivors blacklist the dead peer
+        now = get_dht_time()
+        for i in range(3):
+            await nodes[0].store(f"k{i}", i, now + 30)
+        for node in (nodes[0],):
+            # peer may or may not have been contacted, but if it failed it must be banned
+            if node.blacklist.ban_counter.get(victim_peer, 0) > 0:
+                assert victim_peer in node.blacklist
+        # the swarm still functions
+        assert await nodes[1].get("k0") is not None or await nodes[0].get("k0") is not None
+    finally:
+        await shutdown_swarm([n for n in nodes if n is not nodes[2]])
+
+
+async def test_dht_node_client_mode():
+    nodes = await launch_swarm(3)
+    try:
+        maddrs = [str(m) for m in await nodes[0].get_visible_maddrs()]
+        client = await DHTNode.create(initial_peers=maddrs, client_mode=True)
+        now = get_dht_time()
+        assert await client.store("from_client", "hello", now + 30)
+        assert (await nodes[1].get("from_client")).value == "hello"
+        # client must not appear in anyone's routing table
+        for node in nodes:
+            assert client.node_id not in node.protocol.routing_table
+        await client.shutdown()
+    finally:
+        await shutdown_swarm(nodes)
+
+
+async def test_dht_node_beam_search_matches_direct():
+    """Every value stored anywhere must be retrievable from every node."""
+    nodes = await launch_swarm(10)
+    try:
+        now = get_dht_time()
+        keys = [f"key{i}" for i in range(12)]
+        for i, key in enumerate(keys):
+            assert await nodes[i % len(nodes)].store(key, i, now + 120)
+        random.shuffle(keys)
+        getters = random.choices(nodes, k=len(keys))
+        results = await asyncio.gather(*(node.get(key) for node, key in zip(getters, keys)))
+        for key, result in zip(keys, results):
+            assert result is not None, f"lost {key}"
+            assert result.value == int(key[3:])
+    finally:
+        await shutdown_swarm(nodes)

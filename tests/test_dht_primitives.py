@@ -1,0 +1,195 @@
+"""Unit tests for DHT building blocks: DHTID, routing table, local storage, traversal
+(scope: reference tests/test_routing.py + test_dht_storage.py)."""
+
+import asyncio
+import heapq
+import random
+
+import pytest
+
+from hivemind_tpu.dht.routing import DHTID, KBucket, PeerInfo, RoutingTable
+from hivemind_tpu.dht.storage import DHTLocalStorage, DictionaryDHTValue
+from hivemind_tpu.dht.traverse import simple_traverse_dht, traverse_dht
+from hivemind_tpu.p2p.peer_id import PeerID
+from hivemind_tpu.utils.serializer import MSGPackSerializer
+from hivemind_tpu.utils.timed_storage import get_dht_time
+
+
+def fake_peer_info(seed: int) -> PeerInfo:
+    return PeerInfo(PeerID(seed.to_bytes(8, "big")), (f"/ip4/127.0.0.1/tcp/{seed % 60000 + 1024}",))
+
+
+def test_dhtid_basics():
+    key_id = DHTID.generate(source=b"key")
+    assert key_id == DHTID.generate(source=b"key")  # deterministic for keys
+    assert key_id != DHTID.generate(source=b"key2")
+    assert DHTID.from_bytes(key_id.to_bytes()) == key_id
+    a, b = DHTID.generate(), DHTID.generate()
+    assert a.xor_distance(a) == 0
+    assert a.xor_distance(b) == b.xor_distance(a)
+    c = DHTID.generate()
+    # triangle inequality of xor metric
+    assert a.xor_distance(c) <= a.xor_distance(b) ^ b.xor_distance(c) or True  # xor: d(a,c) = d(a,b)^d(b,c)
+    assert a.xor_distance(c) == a.xor_distance(b) ^ b.xor_distance(c)
+    # msgpack-able sources
+    assert DHTID.generate(source=("tuple", 1)) == DHTID.generate(source=("tuple", 1))
+
+
+def test_kbucket_eviction_and_replacements():
+    bucket = KBucket(0, 2**256, size=3)
+    ids = [DHTID.generate() for _ in range(5)]
+    for i, node_id in enumerate(ids[:3]):
+        assert bucket.add_or_update_node(node_id, fake_peer_info(i))
+    assert not bucket.add_or_update_node(ids[3], fake_peer_info(3))  # full
+    assert ids[3] in bucket.replacement_nodes
+    # removing a live node promotes the replacement
+    bucket.remove_node(ids[0])
+    assert ids[0] not in bucket.nodes_to_peers and ids[3] in bucket.nodes_to_peers
+
+
+def test_routing_table_split_and_nearest():
+    own_id = DHTID.generate()
+    table = RoutingTable(own_id, bucket_size=8)
+    random.seed(42)
+    all_ids = [DHTID.generate() for _ in range(200)]
+    for i, node_id in enumerate(all_ids):
+        table.add_or_update_node(node_id, fake_peer_info(i))
+    assert len(table.buckets) > 1  # must have split
+    assert all(b.lower < b.upper for b in table.buckets)
+    # buckets tile the id space contiguously
+    for left, right in zip(table.buckets, table.buckets[1:]):
+        assert left.upper == right.lower
+    assert table.buckets[0].lower == 0 and table.buckets[-1].upper == 2**256
+
+    query = DHTID.generate()
+    nearest = table.get_nearest_neighbors(query, k=10)
+    in_table = list(table.uid_to_info.keys())
+    expected = heapq.nsmallest(10, in_table, key=query.xor_distance)
+    assert [nid for nid, _ in nearest] == expected
+
+
+def test_local_storage_dictionary_semantics():
+    storage = DHTLocalStorage()
+    key = DHTID.generate(source=b"k")
+    now = get_dht_time()
+    assert storage.store_subkey(key, "alpha", b"1", now + 10)
+    assert storage.store_subkey(key, "beta", b"2", now + 20)
+    entry = storage.get(key)
+    assert isinstance(entry.value, DictionaryDHTValue)
+    assert entry.value.get("alpha").value == b"1"
+    assert entry.expiration_time == now + 20  # container tracks the latest subkey
+    # stale subkey write rejected
+    assert not storage.store_subkey(key, "alpha", b"0", now + 5)
+    # plain value older than the dictionary's latest subkey must not clobber it
+    assert not storage.store(key, b"plain", now + 15)
+    assert isinstance(storage.get(key).value, DictionaryDHTValue)
+    # but a fresher plain value wins
+    assert storage.store(key, b"plain", now + 30)
+    assert storage.get(key).value == b"plain"
+
+
+def test_dictionary_value_serialization():
+    d = DictionaryDHTValue()
+    now = get_dht_time()
+    d.store("x", b"1", now + 10)
+    d.store(("tuple", "subkey"), b"2", now + 20)
+    restored = MSGPackSerializer.loads(MSGPackSerializer.dumps(d))
+    assert isinstance(restored, DictionaryDHTValue)
+    assert restored == d
+    assert restored.latest_expiration_time == d.latest_expiration_time
+
+
+def make_fake_swarm(num_nodes: int, k: int, seed: int = 0):
+    """A static fake swarm where every node has a real Kademlia routing table over all
+    other nodes — get_neighbors answers like rpc_find does (k nearest to the QUERY from
+    the peer's table). A plain kNN graph would not be navigable under the xor metric;
+    bucketed tables cover every distance scale, which is what makes the search converge."""
+    random.seed(seed)
+    node_ids = [DHTID.generate() for _ in range(num_nodes)]
+    tables = {}
+    for node in node_ids:
+        table = RoutingTable(node, bucket_size=k)
+        for i, other in enumerate(node_ids):
+            if other != node:
+                table.add_or_update_node(other, fake_peer_info(i))
+        tables[node] = table
+
+    async def get_neighbors(peer, queries):
+        await asyncio.sleep(random.random() * 0.001)
+        return {
+            q: ([nid for nid, _ in tables[peer].get_nearest_neighbors(q, k)], False) for q in queries
+        }
+
+    return node_ids, get_neighbors
+
+
+async def test_traverse_matches_exhaustive_search():
+    # bucket_size >= swarm size: full knowledge, so beam search must be *exact*
+    # (the reference's beam-vs-exhaustive test makes the same assumption)
+    node_ids, get_neighbors = make_fake_swarm(60, k=60)
+    beam_size = 10
+    query = DHTID.generate()
+    initial = random.sample(node_ids, 3)
+
+    simple_nearest, _ = await simple_traverse_dht(query, initial, beam_size, get_neighbors)
+    nearest, visited = await traverse_dht(
+        [query], initial, beam_size, num_workers=3, queries_per_call=1, get_neighbors=get_neighbors
+    )
+    exhaustive = heapq.nsmallest(beam_size, node_ids, key=query.xor_distance)
+    assert simple_nearest == exhaustive
+    assert nearest[query] == exhaustive
+
+
+async def test_traverse_navigability_with_small_buckets():
+    # with small buckets, far-region precision is approximate, but the query's own
+    # neighborhood is finely bucketed: the closest nodes must always be found
+    node_ids, get_neighbors = make_fake_swarm(100, k=8, seed=3)
+    for _ in range(3):
+        query = DHTID.generate()
+        initial = random.sample(node_ids, 3)
+        nearest, _ = await traverse_dht(
+            [query], initial, beam_size=10, num_workers=3, queries_per_call=1,
+            get_neighbors=get_neighbors,
+        )
+        exhaustive = heapq.nsmallest(10, node_ids, key=query.xor_distance)
+        assert nearest[query][:3] == exhaustive[:3]
+
+
+async def test_traverse_multiple_queries_and_callbacks():
+    node_ids, get_neighbors = make_fake_swarm(50, k=50, seed=1)
+    queries = [DHTID.generate() for _ in range(4)]
+    initial = random.sample(node_ids, 3)
+    finished = []
+
+    async def callback(query, nearest, visited):
+        finished.append(query)
+
+    nearest, visited = await traverse_dht(
+        queries, initial, beam_size=8, num_workers=4, queries_per_call=3,
+        get_neighbors=get_neighbors, found_callback=callback,
+    )
+    assert sorted(finished) == sorted(queries)
+    for query in queries:
+        exhaustive = heapq.nsmallest(8, node_ids, key=query.xor_distance)
+        assert nearest[query] == exhaustive
+
+
+async def test_traverse_early_stop():
+    node_ids, base_get_neighbors = make_fake_swarm(50, k=5, seed=2)
+    query = DHTID.generate()
+    stop_at = heapq.nsmallest(3, node_ids, key=query.xor_distance)[-1]
+    calls = []
+
+    async def get_neighbors(peer, queries):
+        calls.append(peer)
+        out = await base_get_neighbors(peer, queries)
+        if peer == stop_at:
+            return {q: (n, True) for q, (n, _) in out.items()}
+        return out
+
+    nearest, _ = await traverse_dht(
+        [query], random.sample(node_ids, 3), beam_size=10, num_workers=1, queries_per_call=1,
+        get_neighbors=get_neighbors,
+    )
+    # should_stop truncates the search once the target peer responds
+    assert stop_at in calls
