@@ -1,0 +1,26 @@
+from hivemind_tpu.compression.adaptive import (
+    PerTensorCompression,
+    RoleAdaptiveCompression,
+    SizeAdaptiveCompression,
+)
+from hivemind_tpu.compression.base import (
+    CompressionBase,
+    CompressionInfo,
+    CompressionType,
+    NoCompression,
+    TensorRole,
+)
+from hivemind_tpu.compression.floating import Float16Compression, ScaledFloat16Compression
+from hivemind_tpu.compression.quantization import (
+    BlockwiseQuantization,
+    Quantile8BitQuantization,
+    Uniform8BitQuantization,
+)
+from hivemind_tpu.compression.serialization import (
+    deserialize_tensor,
+    deserialize_tensor_stream,
+    deserialize_to_jax,
+    get_codec,
+    serialize_tensor,
+    split_tensor_for_streaming,
+)

@@ -1,0 +1,97 @@
+"""Compression framework (capability parity: reference hivemind/compression/base.py).
+
+Codecs turn arrays (numpy or jax; bfloat16 is first-class) into ``runtime_pb2.Tensor``
+messages and back. Unlike the reference, there is no legacy-bfloat16 env switch: TPU
+tensors are bf16-native and serialize as raw bf16 bytes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from abc import ABC, abstractmethod
+from enum import Enum
+from typing import Any, Optional
+
+import numpy as np
+
+from hivemind_tpu.proto import runtime_pb2
+from hivemind_tpu.utils.tensor_descr import TensorDescriptor, numpy_dtype
+
+CompressionType = runtime_pb2.CompressionType
+
+
+class TensorRole(Enum):
+    ACTIVATION = "activation"
+    PARAMETER = "parameter"
+    GRADIENT = "gradient"
+    OPTIMIZER = "optimizer"
+    UNSPECIFIED = "unspecified"
+
+
+@dataclasses.dataclass(frozen=True)
+class CompressionInfo:
+    """Metadata a codec may use to decide how to compress
+    (reference compression/base.py:30-45)."""
+
+    key: Any = None
+    descriptor: Optional[TensorDescriptor] = None
+    role: TensorRole = TensorRole.UNSPECIFIED
+    part_index: int = 0
+    part_size: Optional[int] = None
+
+    @classmethod
+    def from_array(cls, array: Any, key: Any = None, role: TensorRole = TensorRole.UNSPECIFIED) -> "CompressionInfo":
+        return cls(key=key, descriptor=TensorDescriptor.from_array(array), role=role)
+
+
+def as_numpy(array: Any) -> np.ndarray:
+    """View any array (numpy / jax, incl. bfloat16) as numpy without copying when
+    possible. jax device arrays are fetched to host."""
+    if isinstance(array, np.ndarray):
+        return array
+    return np.asarray(array)
+
+
+def _dtype_name(array: np.ndarray) -> str:
+    return "bfloat16" if str(array.dtype) == "bfloat16" else array.dtype.name
+
+
+class CompressionBase(ABC):
+    compression_type: int = CompressionType.NONE
+
+    @abstractmethod
+    def compress(self, array: Any, info: Optional[CompressionInfo] = None, allow_inplace: bool = False) -> runtime_pb2.Tensor:
+        """Encode an array into a protobuf Tensor."""
+
+    @abstractmethod
+    def extract(self, serialized: runtime_pb2.Tensor) -> np.ndarray:
+        """Decode a protobuf Tensor back into a numpy array."""
+
+    def estimate_compression_ratio(self, info: CompressionInfo) -> float:
+        """compressed size / original size (approximate)."""
+        return 1.0
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+
+class NoCompression(CompressionBase):
+    """Raw little-endian bytes; bfloat16 serialized natively
+    (reference base.py:79-122 upcasts bf16 unless a legacy env is set — deviation noted)."""
+
+    compression_type = CompressionType.NONE
+
+    def compress(self, array: Any, info: Optional[CompressionInfo] = None, allow_inplace: bool = False) -> runtime_pb2.Tensor:
+        array = as_numpy(array)
+        return runtime_pb2.Tensor(
+            buffer=array.tobytes(),
+            size=array.shape,
+            dtype=_dtype_name(array),
+            requires_grad=bool(getattr(array, "requires_grad", False)),
+            compression=self.compression_type,
+        )
+
+    def extract(self, serialized: runtime_pb2.Tensor) -> np.ndarray:
+        dtype = numpy_dtype(serialized.dtype)
+        array = np.frombuffer(serialized.buffer, dtype=dtype)
+        return array.reshape(tuple(serialized.size)).copy()

@@ -1,0 +1,90 @@
+"""Float16 codecs (capability parity: reference hivemind/compression/floating.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from hivemind_tpu.compression.base import (
+    CompressionBase,
+    CompressionInfo,
+    CompressionType,
+    as_numpy,
+)
+from hivemind_tpu.proto import runtime_pb2
+
+FP16_MAX = 65504.0
+
+
+class Float16Compression(CompressionBase):
+    """Clamp to the fp16 range and cast (reference floating.py:10-40)."""
+
+    compression_type = CompressionType.FLOAT16
+
+    def compress(self, array: Any, info: Optional[CompressionInfo] = None, allow_inplace: bool = False) -> runtime_pb2.Tensor:
+        array = as_numpy(array)
+        original_dtype = "bfloat16" if str(array.dtype) == "bfloat16" else array.dtype.name
+        clipped = np.clip(array.astype(np.float32), -FP16_MAX, FP16_MAX).astype(np.float16)
+        return runtime_pb2.Tensor(
+            buffer=clipped.tobytes(),
+            size=array.shape,
+            dtype=original_dtype,
+            compression=self.compression_type,
+        )
+
+    def extract(self, serialized: runtime_pb2.Tensor) -> np.ndarray:
+        from hivemind_tpu.utils.tensor_descr import numpy_dtype
+
+        half = np.frombuffer(serialized.buffer, dtype=np.float16)
+        return half.astype(numpy_dtype(serialized.dtype or "float32")).reshape(tuple(serialized.size))
+
+    def estimate_compression_ratio(self, info: CompressionInfo) -> float:
+        return 16.0 / (8 * (info.descriptor.itemsize if info.descriptor else 4))
+
+
+class ScaledFloat16Compression(Float16Compression):
+    """Normalize per last axis by mean/std, cast to fp16, and ship the fp32 stats
+    alongside (reference floating.py:43-91, MEANSTD_16BIT)."""
+
+    compression_type = CompressionType.MEANSTD_16BIT
+
+    def compress(self, array: Any, info: Optional[CompressionInfo] = None, allow_inplace: bool = False) -> runtime_pb2.Tensor:
+        array = as_numpy(array)
+        original_dtype = "bfloat16" if str(array.dtype) == "bfloat16" else array.dtype.name
+        array32 = array.astype(np.float32)
+        if array32.ndim == 0:
+            array32 = array32.reshape(1)
+            means = np.zeros(1, np.float32)
+            stds = np.ones(1, np.float32)
+            normalized = array32
+        else:
+            means = array32.mean(axis=-1, keepdims=True, dtype=np.float32)
+            stds = array32.std(axis=-1, keepdims=True, dtype=np.float32) + 1e-6
+            normalized = (array32 - means) / stds
+        half = np.clip(normalized, -FP16_MAX, FP16_MAX).astype(np.float16)
+        buffer = half.tobytes() + means.astype(np.float32).tobytes() + stds.astype(np.float32).tobytes()
+        return runtime_pb2.Tensor(
+            buffer=buffer,
+            size=array.shape,
+            dtype=original_dtype,
+            compression=self.compression_type,
+        )
+
+    def extract(self, serialized: runtime_pb2.Tensor) -> np.ndarray:
+        from hivemind_tpu.utils.tensor_descr import numpy_dtype
+
+        shape = tuple(serialized.size)
+        numel = int(np.prod(shape)) if shape else 1
+        stats_shape = (*shape[:-1], 1) if shape else (1,)
+        stats_count = int(np.prod(stats_shape))
+        half_bytes = numel * 2
+        half = np.frombuffer(serialized.buffer, dtype=np.float16, count=numel)
+        means = np.frombuffer(serialized.buffer, dtype=np.float32, count=stats_count, offset=half_bytes)
+        stds = np.frombuffer(
+            serialized.buffer, dtype=np.float32, count=stats_count, offset=half_bytes + stats_count * 4
+        )
+        restored = half.astype(np.float32).reshape(shape or (1,))
+        restored = restored * stds.reshape(stats_shape) + means.reshape(stats_shape)
+        out = restored.astype(numpy_dtype(serialized.dtype or "float32"))
+        return out.reshape(shape) if shape else out.reshape(())

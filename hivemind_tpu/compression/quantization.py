@@ -1,0 +1,109 @@
+"""8-bit quantization codecs (capability parity: reference
+hivemind/compression/quantization.py). The math lives in hivemind_tpu.ops.quantization
+as jitted jax functions — on TPU inputs it runs on device; numpy inputs go through the
+CPU jax backend (same code, no thread-pool machinery needed)."""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Optional
+
+import numpy as np
+
+from hivemind_tpu.compression.base import (
+    CompressionBase,
+    CompressionInfo,
+    CompressionType,
+    as_numpy,
+)
+from hivemind_tpu.ops.quantization import (
+    BLOCKWISE_BLOCK_SIZE,
+    blockwise_quantize,
+    dequantize_with_codebook,
+    pad_to_block,
+    quantile_quantize,
+    uniform_quantize,
+)
+from hivemind_tpu.proto import runtime_pb2
+
+
+class _CodebookQuantization(CompressionBase):
+    """Shared wire format: [u32 codebook_size][fp32 codebook][u8 codes]."""
+
+    def _quantize(self, flat32):
+        raise NotImplementedError
+
+    def compress(self, array: Any, info: Optional[CompressionInfo] = None, allow_inplace: bool = False) -> runtime_pb2.Tensor:
+        array = as_numpy(array)
+        original_dtype = "bfloat16" if str(array.dtype) == "bfloat16" else array.dtype.name
+        flat = np.ascontiguousarray(array, dtype=np.float32).reshape(-1)
+        codes, codebook = self._quantize(flat)
+        codes, codebook = np.asarray(codes), np.asarray(codebook)
+        buffer = struct.pack("<I", codebook.size) + codebook.astype(np.float32).tobytes() + codes.tobytes()
+        return runtime_pb2.Tensor(
+            buffer=buffer, size=array.shape, dtype=original_dtype, compression=self.compression_type
+        )
+
+    def extract(self, serialized: runtime_pb2.Tensor) -> np.ndarray:
+        from hivemind_tpu.utils.tensor_descr import numpy_dtype
+
+        (codebook_size,) = struct.unpack_from("<I", serialized.buffer)
+        codebook = np.frombuffer(serialized.buffer, dtype=np.float32, count=codebook_size, offset=4)
+        codes = np.frombuffer(serialized.buffer, dtype=np.uint8, offset=4 + codebook_size * 4)
+        restored = dequantize_with_codebook(codes, codebook)
+        return restored.astype(numpy_dtype(serialized.dtype or "float32")).reshape(tuple(serialized.size))
+
+    def estimate_compression_ratio(self, info: CompressionInfo) -> float:
+        return 8.0 / (8 * (info.descriptor.itemsize if info.descriptor else 4))
+
+
+class Uniform8BitQuantization(_CodebookQuantization):
+    compression_type = CompressionType.UNIFORM_8BIT
+
+    def _quantize(self, flat32):
+        return uniform_quantize(flat32)
+
+
+class Quantile8BitQuantization(_CodebookQuantization):
+    compression_type = CompressionType.QUANTILE_8BIT
+
+    def _quantize(self, flat32):
+        return quantile_quantize(flat32)
+
+
+class BlockwiseQuantization(CompressionBase):
+    """Per-4096-block absmax int8 (reference quantization.py:130-201 via bitsandbytes;
+    here a jitted jax op — see ops/quantization.py for the deviation note).
+    Wire format: [u32 n_blocks][u32 true_size][fp32 absmax per block][i8 codes]."""
+
+    compression_type = CompressionType.BLOCKWISE_8BIT
+
+    def compress(self, array: Any, info: Optional[CompressionInfo] = None, allow_inplace: bool = False) -> runtime_pb2.Tensor:
+        array = as_numpy(array)
+        original_dtype = "bfloat16" if str(array.dtype) == "bfloat16" else array.dtype.name
+        flat = np.ascontiguousarray(array, dtype=np.float32).reshape(-1)
+        padded, true_size = pad_to_block(flat)
+        codes, absmax = blockwise_quantize(padded)
+        codes, absmax = np.asarray(codes), np.asarray(absmax)
+        buffer = (
+            struct.pack("<II", absmax.size, true_size)
+            + absmax.astype(np.float32).tobytes()
+            + codes.tobytes()
+        )
+        return runtime_pb2.Tensor(
+            buffer=buffer, size=array.shape, dtype=original_dtype, compression=self.compression_type
+        )
+
+    def extract(self, serialized: runtime_pb2.Tensor) -> np.ndarray:
+        from hivemind_tpu.ops.quantization import blockwise_dequantize
+        from hivemind_tpu.utils.tensor_descr import numpy_dtype
+
+        n_blocks, true_size = struct.unpack_from("<II", serialized.buffer)
+        absmax = np.frombuffer(serialized.buffer, dtype=np.float32, count=n_blocks, offset=8)
+        codes = np.frombuffer(serialized.buffer, dtype=np.int8, offset=8 + n_blocks * 4)
+        codes = codes.reshape(n_blocks, -1)
+        restored = np.asarray(blockwise_dequantize(codes, absmax))[:true_size]
+        return restored.astype(numpy_dtype(serialized.dtype or "float32")).reshape(tuple(serialized.size))
+
+    def estimate_compression_ratio(self, info: CompressionInfo) -> float:
+        return 8.25 / (8 * (info.descriptor.itemsize if info.descriptor else 4))

@@ -1,0 +1,97 @@
+"""Serialization facade: one codec instance per CompressionType enum value
+(capability parity: reference hivemind/compression/serialization.py:13-68)."""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, List, Optional
+
+import numpy as np
+
+from hivemind_tpu.compression.base import (
+    CompressionBase,
+    CompressionInfo,
+    CompressionType,
+    NoCompression,
+)
+from hivemind_tpu.compression.floating import Float16Compression, ScaledFloat16Compression
+from hivemind_tpu.compression.quantization import (
+    BlockwiseQuantization,
+    Quantile8BitQuantization,
+    Uniform8BitQuantization,
+)
+from hivemind_tpu.proto import runtime_pb2
+
+_CODECS = {
+    CompressionType.NONE: NoCompression(),
+    CompressionType.FLOAT16: Float16Compression(),
+    CompressionType.MEANSTD_16BIT: ScaledFloat16Compression(),
+    CompressionType.UNIFORM_8BIT: Uniform8BitQuantization(),
+    CompressionType.QUANTILE_8BIT: Quantile8BitQuantization(),
+    CompressionType.BLOCKWISE_8BIT: BlockwiseQuantization(),
+}
+
+for _value in runtime_pb2.CompressionType.values():
+    assert _value in _CODECS, f"no codec registered for CompressionType={_value}"
+
+
+def get_codec(compression_type: int) -> CompressionBase:
+    return _CODECS[compression_type]
+
+
+def serialize_tensor(
+    array: Any,
+    compression: CompressionBase | int = CompressionType.NONE,
+    info: Optional[CompressionInfo] = None,
+    allow_inplace: bool = False,
+) -> runtime_pb2.Tensor:
+    if isinstance(compression, int):
+        compression = _CODECS[compression]
+    return compression.compress(array, info, allow_inplace)
+
+
+def deserialize_tensor(serialized: runtime_pb2.Tensor) -> np.ndarray:
+    return _CODECS[serialized.compression].extract(serialized)
+
+
+def deserialize_to_jax(serialized: runtime_pb2.Tensor):
+    import jax.numpy as jnp
+
+    return jnp.asarray(deserialize_tensor(serialized))
+
+
+async def deserialize_tensor_stream(stream: AsyncIterator[List[runtime_pb2.Tensor]]) -> List[np.ndarray]:
+    """Reassemble tensors from a stream of chunked parts: each tensor arrives as its
+    first message (with ``chunks`` = total count) followed by buffer-only continuation
+    messages (reference serialization.py deserialize_tensor_stream)."""
+    tensors: List[np.ndarray] = []
+    parts: List[runtime_pb2.Tensor] = []
+    async for chunk_batch in stream:
+        for chunk in chunk_batch:
+            parts.append(chunk)
+            total = parts[0].chunks or 1
+            if len(parts) == total:
+                combined = runtime_pb2.Tensor()
+                combined.CopyFrom(parts[0])
+                combined.buffer = b"".join(p.buffer for p in parts)
+                combined.chunks = 0
+                tensors.append(deserialize_tensor(combined))
+                parts = []
+    if parts:
+        raise ValueError(f"stream ended mid-tensor: got {len(parts)}/{parts[0].chunks} chunks")
+    return tensors
+
+
+def split_tensor_for_streaming(serialized: runtime_pb2.Tensor, chunk_size_bytes: int) -> List[runtime_pb2.Tensor]:
+    """Split one serialized tensor into wire-sized chunk messages (the inverse of
+    deserialize_tensor_stream's reassembly)."""
+    from hivemind_tpu.utils.streaming import split_for_streaming
+
+    buffers = list(split_for_streaming(serialized.buffer, chunk_size_bytes))
+    first = runtime_pb2.Tensor()
+    first.CopyFrom(serialized)
+    first.buffer = buffers[0]
+    first.chunks = len(buffers)
+    out = [first]
+    for extra in buffers[1:]:
+        out.append(runtime_pb2.Tensor(buffer=extra))
+    return out

@@ -142,7 +142,7 @@ class DHT:
         self._record_validator.extend(record_validators)
 
     def get_visible_maddrs(self, latest: bool = False) -> List[Multiaddr]:
-        return self._runner.run_coroutine(self.node.get_visible_maddrs())
+        return self._runner.run_coroutine(self.node.get_visible_maddrs(latest))
 
     @property
     def peer_id(self) -> PeerID:

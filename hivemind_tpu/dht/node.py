@@ -262,9 +262,13 @@ class DHTNode:
         k_nearest = k_nearest if k_nearest is not None else self.beam_size
         beam_size = max(beam_size if beam_size is not None else self.beam_size, k_nearest)
         node_to_peer = self._make_peer_resolver()
-        initial = [nid for nid, _ in self.protocol.routing_table.get_nearest_neighbors(
-            random.choice(queries), beam_size
-        )]
+        # seed each query's beam from its OWN neighborhood (cheap local op); distant
+        # queries would otherwise converge from a random region, wasting round-trips
+        initial_set = {}
+        for query in queries:
+            for nid, _info in self.protocol.routing_table.get_nearest_neighbors(query, beam_size):
+                initial_set[nid] = None
+        initial = list(initial_set)
         if not initial:
             # lone node (or empty table): the only known storage candidate is self
             if exclude_self:
@@ -348,21 +352,37 @@ class DHTNode:
                         output[result_key] = output.get(result_key, False) or ok
                 else:
                     store_tasks.append(
-                        self.protocol.call_store(
-                            info.peer_id,
-                            keys=[key_id] * len(records),
-                            values=[r[1] for r in records],
-                            expiration_time=[r[2] for r in records],
-                            subkeys=[r[0] for r in records],
+                        asyncio.ensure_future(
+                            self.protocol.call_store(
+                                info.peer_id,
+                                keys=[key_id] * len(records),
+                                values=[r[1] for r in records],
+                                expiration_time=[r[2] for r in records],
+                                subkeys=[r[0] for r in records],
+                            )
                         )
                     )
-            if store_tasks:
-                replies = await asyncio.gather(*store_tasks)
-                for reply in replies:
-                    if reply is None:
-                        continue
-                    for (subkey, _bv, _exp, result_key), ok in zip(records, reply):
-                        output[result_key] = output.get(result_key, False) or bool(ok)
+
+            def _register(reply) -> None:
+                if reply is None:
+                    return
+                for (subkey, _bv, _exp, result_key), ok in zip(records, reply):
+                    output[result_key] = output.get(result_key, False) or bool(ok)
+
+            def _all_succeeded() -> bool:
+                return all(output.get(r[3], False) for r in records)
+
+            if await_all_replicas:
+                for reply in await asyncio.gather(*store_tasks):
+                    _register(reply)
+            else:
+                # return as soon as every record has one replica; stragglers finish
+                # in the background (reference node.py await_all_replicas=False)
+                pending = set(store_tasks)
+                while pending and not _all_succeeded():
+                    done, pending = await asyncio.wait(pending, return_when=asyncio.FIRST_COMPLETED)
+                    for task in done:
+                        _register(task.result())
             for _subkey, _bv, _exp, result_key in records:
                 output.setdefault(result_key, False)
 
@@ -507,8 +527,9 @@ class DHTNode:
             future.set_result(None)
             return
         future.set_result(result)
-        # local caching + refresh scheduling
-        if self.cache_locally and not is_refresh and state.source_node_id != self.node_id:
+        # local caching + refresh scheduling (refreshes always re-store, else the
+        # refresh traversal would accomplish nothing)
+        if (self.cache_locally or is_refresh) and state.source_node_id != self.node_id:
             self.protocol.cache.store(key_id, state.binary_value, state.expiration_time)
         if self.cache_refresh_before_expiry > 0 and key_id in self.protocol.cache:
             self._schedule_cache_refresh(key_id, state.expiration_time)
@@ -595,8 +616,8 @@ class DHTNode:
 
     # ------------------------------------------------------------------ misc
 
-    async def get_visible_maddrs(self) -> List[Multiaddr]:
-        return self.p2p.get_visible_maddrs()
+    async def get_visible_maddrs(self, latest: bool = False) -> List[Multiaddr]:
+        return self.p2p.get_visible_maddrs(latest)
 
     async def shutdown(self) -> None:
         if self._refresh_task is not None:

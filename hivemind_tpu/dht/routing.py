@@ -104,14 +104,19 @@ class KBucket:
                 return node_id, info
         return None
 
-    def remove_node(self, node_id: DHTID) -> None:
+    def remove_node(self, node_id: DHTID) -> Optional[Tuple[DHTID, PeerInfo]]:
+        """Drop a node; promote the oldest replacement into the freed live slot.
+        Returns the promoted (id, info) so the owning table can register it."""
         self.nodes_requested_for_ping.discard(node_id)
+        promoted = None
         if node_id in self.nodes_to_peers:
             del self.nodes_to_peers[node_id]
             if self.replacement_nodes:
                 replacement_id, info = self.replacement_nodes.popitem(last=False)
                 self.nodes_to_peers[replacement_id] = info
+                promoted = (replacement_id, info)
         self.replacement_nodes.pop(node_id, None)
+        return promoted
 
     def split(self) -> Tuple["KBucket", "KBucket"]:
         midpoint = (self.lower + self.upper) // 2
@@ -160,8 +165,7 @@ class RoutingTable:
         bucket = self.buckets[bucket_index]
         store_success = bucket.add_or_update_node(node_id, info)
         if store_success:
-            self.peer_to_uid[info.peer_id] = node_id
-            self.uid_to_info[node_id] = info
+            self._register_live(node_id, info)
             return None
         # full bucket: split if it covers our own id (or depth rule), else request ping
         if bucket.has_in_range(self.node_id) or self._bucket_depth(bucket) % self.depth_modulo != 0:
@@ -175,13 +179,24 @@ class RoutingTable:
     def split_bucket(self, index: int) -> None:
         left, right = self.buckets[index].split()
         self.buckets[index : index + 1] = [left, right]
+        # replacements may have been promoted into the new buckets' live slots;
+        # register every live node so lookups can see them
+        for bucket in (left, right):
+            for node_id, info in bucket.nodes_to_peers.items():
+                self._register_live(node_id, info)
+
+    def _register_live(self, node_id: DHTID, info: PeerInfo) -> None:
+        self.peer_to_uid[info.peer_id] = node_id
+        self.uid_to_info[node_id] = info
 
     def remove_node(self, node_id: DHTID) -> None:
         bucket = self.buckets[self.get_bucket_index(node_id)]
         info = self.uid_to_info.pop(node_id, None)
         if info is not None:
             self.peer_to_uid.pop(info.peer_id, None)
-        bucket.remove_node(node_id)
+        promoted = bucket.remove_node(node_id)
+        if promoted is not None:
+            self._register_live(*promoted)
 
     def get_info(self, node_id: DHTID) -> Optional[PeerInfo]:
         return self.uid_to_info.get(node_id)

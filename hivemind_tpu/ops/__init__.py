@@ -1,0 +1,7 @@
+from hivemind_tpu.ops.quantization import (
+    BLOCKWISE_BLOCK_SIZE,
+    blockwise_dequantize,
+    blockwise_quantize,
+    quantile_quantize,
+    uniform_quantize,
+)

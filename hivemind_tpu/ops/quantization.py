@@ -1,0 +1,94 @@
+"""Jitted quantization math — the device-side half of the compression codecs.
+
+The reference's 8-bit path is a C++/CUDA bitsandbytes kernel
+(hivemind/compression/quantization.py:130-201); here the equivalents are jax
+functions that XLA fuses/tiles for TPU (a Pallas kernel would only matter for
+enormous tensors; XLA's fusion already saturates HBM bandwidth for these shapes).
+All functions also run under the CPU backend for host-side use.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BLOCKWISE_BLOCK_SIZE = 4096  # parity with the reference's bitsandbytes blocksize
+UNIFORM_NUM_BUCKETS = 256
+UNIFORM_RANGE_IN_SIGMAS = 6.0
+
+
+@partial(jax.jit, static_argnames=("block_size",))
+def blockwise_quantize(flat: jax.Array, block_size: int = BLOCKWISE_BLOCK_SIZE):
+    """Per-block absmax int8 quantization of a flat (padded) array.
+
+    :returns: (int8 codes [n_blocks, block_size], fp32 absmax [n_blocks])
+    Deviation from the reference: bitsandbytes uses a dynamic-tree codebook; linear
+    absmax int8 has comparable error for gradient averaging and maps directly onto
+    vectorized TPU ops.
+    """
+    blocks = flat.reshape(-1, block_size)
+    absmax = jnp.max(jnp.abs(blocks), axis=1)
+    scale = jnp.where(absmax > 0, 127.0 / absmax, 0.0)
+    codes = jnp.clip(jnp.round(blocks * scale[:, None]), -127, 127).astype(jnp.int8)
+    return codes, absmax.astype(jnp.float32)
+
+
+@partial(jax.jit, static_argnames=("block_size",))
+def blockwise_dequantize(codes: jax.Array, absmax: jax.Array, block_size: int = BLOCKWISE_BLOCK_SIZE):
+    scale = absmax / 127.0
+    return (codes.astype(jnp.float32) * scale[:, None]).reshape(-1)
+
+
+@jax.jit
+def uniform_quantize(flat: jax.Array):
+    """Uniform 8-bit quantization over [mean - 6σ, mean + 6σ] with a bucket-mean
+    codebook (parity: reference quantization.py:60-74,88-93).
+
+    :returns: (uint8 codes, fp32 codebook [256])
+    """
+    flat32 = flat.astype(jnp.float32)
+    mean, std = jnp.mean(flat32), jnp.std(flat32) + 1e-11
+    lo = mean - UNIFORM_RANGE_IN_SIGMAS * std
+    hi = mean + UNIFORM_RANGE_IN_SIGMAS * std
+    scale = (UNIFORM_NUM_BUCKETS - 1) / (hi - lo)
+    codes = jnp.clip(jnp.round((flat32 - lo) * scale), 0, UNIFORM_NUM_BUCKETS - 1).astype(jnp.uint8)
+    # bucket-mean codebook: average of the elements that landed in each bucket;
+    # empty buckets fall back to the bucket midpoint
+    sums = jnp.zeros(UNIFORM_NUM_BUCKETS, jnp.float32).at[codes].add(flat32)
+    counts = jnp.zeros(UNIFORM_NUM_BUCKETS, jnp.float32).at[codes].add(1.0)
+    midpoints = lo + (jnp.arange(UNIFORM_NUM_BUCKETS, dtype=jnp.float32) + 0.5) / scale
+    codebook = jnp.where(counts > 0, sums / jnp.maximum(counts, 1.0), midpoints)
+    return codes, codebook
+
+
+@jax.jit
+def quantile_quantize(flat: jax.Array):
+    """Quantile 8-bit quantization: the codebook is the 256 empirical quantiles
+    (parity: reference quantization.py:77-122, which approximates via
+    quantile-of-quantiles across a thread pool — here a single vectorized op).
+
+    :returns: (uint8 codes, fp32 codebook [256])
+    """
+    flat32 = flat.astype(jnp.float32)
+    quantiles = jnp.linspace(0.5 / UNIFORM_NUM_BUCKETS, 1 - 0.5 / UNIFORM_NUM_BUCKETS, UNIFORM_NUM_BUCKETS)
+    codebook = jnp.quantile(flat32, quantiles)
+    edges = (codebook[1:] + codebook[:-1]) / 2
+    codes = jnp.searchsorted(edges, flat32).astype(jnp.uint8)
+    return codes, codebook.astype(jnp.float32)
+
+
+def dequantize_with_codebook(codes: np.ndarray, codebook: np.ndarray) -> np.ndarray:
+    """Host-side lookup decode (cheap gather; no jit needed)."""
+    return codebook[codes.astype(np.int64)]
+
+
+def pad_to_block(flat: np.ndarray, block_size: int = BLOCKWISE_BLOCK_SIZE) -> tuple:
+    """Pad a flat array to a multiple of block_size; returns (padded, original_size)."""
+    remainder = flat.size % block_size
+    if remainder == 0:
+        return flat, flat.size
+    padded = np.concatenate([flat, np.zeros(block_size - remainder, dtype=flat.dtype)])
+    return padded, flat.size

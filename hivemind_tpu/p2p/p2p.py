@@ -185,6 +185,7 @@ class P2P:
 
         peer_id = PeerID.from_public_key(Ed25519PublicKey.from_bytes(extras["static"]))
         self._register_peer_addrs(peer_id, extras.get("addrs", ()))
+        self._prune_dead_connections()
         conn = MuxConnection(channel, peer_id, is_initiator=False, on_inbound_stream=self._route_stream)
         existing = self._connections.get(peer_id)
         if existing is None or existing.is_closed:
@@ -241,7 +242,15 @@ class P2P:
         conn.start()
         return conn
 
+    def _prune_dead_connections(self) -> None:
+        dead = [c for c in self._all_connections if c.is_closed]
+        for conn in dead:
+            self._all_connections.discard(conn)
+            if self._connections.get(conn.peer_id) is conn:
+                del self._connections[conn.peer_id]
+
     async def _get_connection(self, peer_id: PeerID) -> MuxConnection:
+        self._prune_dead_connections()
         conn = self._connections.get(peer_id)
         if conn is not None and not conn.is_closed:
             return conn

@@ -1,0 +1,160 @@
+"""Codec round-trips, error bounds, adaptive selection, tensor streaming
+(scope: reference tests/test_compression.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hivemind_tpu.compression import (
+    BlockwiseQuantization,
+    CompressionInfo,
+    CompressionType,
+    Float16Compression,
+    NoCompression,
+    PerTensorCompression,
+    Quantile8BitQuantization,
+    RoleAdaptiveCompression,
+    ScaledFloat16Compression,
+    SizeAdaptiveCompression,
+    TensorRole,
+    Uniform8BitQuantization,
+    deserialize_tensor,
+    deserialize_tensor_stream,
+    serialize_tensor,
+    split_tensor_for_streaming,
+)
+
+ALL_CODECS = [
+    NoCompression(),
+    Float16Compression(),
+    ScaledFloat16Compression(),
+    Uniform8BitQuantization(),
+    Quantile8BitQuantization(),
+    BlockwiseQuantization(),
+]
+
+
+@pytest.mark.parametrize("codec", ALL_CODECS, ids=lambda c: type(c).__name__)
+def test_codec_roundtrip_shape_dtype(codec):
+    rng = np.random.RandomState(0)
+    for shape in [(1000,), (32, 71), (2, 3, 5, 7), ()]:
+        original = np.asarray(rng.randn(*shape), dtype=np.float32)
+        restored = deserialize_tensor(codec.compress(original))
+        assert restored.shape == original.shape
+        assert restored.dtype == original.dtype
+
+
+@pytest.mark.parametrize(
+    "codec,max_rel_error",
+    [
+        (NoCompression(), 0.0),
+        (Float16Compression(), 1e-3),
+        (ScaledFloat16Compression(), 1e-3),
+        (Uniform8BitQuantization(), 0.1),
+        (Quantile8BitQuantization(), 0.1),
+        (BlockwiseQuantization(), 0.05),
+    ],
+    ids=lambda x: type(x).__name__ if not isinstance(x, float) else str(x),
+)
+def test_codec_error_bounds(codec, max_rel_error):
+    rng = np.random.RandomState(42)
+    original = rng.randn(50_000).astype(np.float32)
+    restored = deserialize_tensor(codec.compress(original))
+    rel_error = np.abs(restored - original).mean() / np.abs(original).mean()
+    assert rel_error <= max_rel_error, f"{type(codec).__name__}: rel_error={rel_error}"
+
+
+def test_codecs_preserve_scale_outliers():
+    """Blockwise quantization must adapt to per-block scale differences."""
+    original = np.concatenate([np.random.randn(4096) * 1e-4, np.random.randn(4096) * 1e2]).astype(np.float32)
+    restored = deserialize_tensor(BlockwiseQuantization().compress(original))
+    small, large = restored[:4096], restored[4096:]
+    assert np.abs(small - original[:4096]).mean() < 1e-5  # small block keeps its resolution
+    assert np.abs(large - original[4096:]).mean() / 1e2 < 0.01
+
+
+def test_bfloat16_roundtrip():
+    original = jnp.asarray(np.random.randn(128, 16), dtype=jnp.bfloat16)
+    serialized = serialize_tensor(original, CompressionType.NONE)
+    restored = deserialize_tensor(serialized)
+    assert str(restored.dtype) == "bfloat16"
+    assert np.array_equal(np.asarray(original, dtype=np.float32), np.asarray(restored, dtype=np.float32))
+    # lossy codecs restore to the original dtype as well
+    serialized16 = serialize_tensor(original, CompressionType.FLOAT16)
+    restored16 = deserialize_tensor(serialized16)
+    assert str(restored16.dtype) == "bfloat16"
+
+
+def test_jax_array_input():
+    original = jnp.arange(1000, dtype=jnp.float32) / 7
+    scale = float(jnp.abs(original).max())  # tolerances are relative to value scale
+    for ct, tol in [
+        (CompressionType.NONE, 0.0),
+        (CompressionType.FLOAT16, 1e-3),
+        (CompressionType.BLOCKWISE_8BIT, 1e-2),
+    ]:
+        restored = deserialize_tensor(serialize_tensor(original, ct))
+        assert np.abs(restored - np.asarray(original)).max() <= tol * scale
+
+
+def test_size_adaptive_compression():
+    adaptive = SizeAdaptiveCompression(
+        threshold=2**10, less=NoCompression(), greater_equal=Float16Compression()
+    )
+    small = np.random.randn(10).astype(np.float32)
+    large = np.random.randn(2**11).astype(np.float32)
+    assert adaptive.compress(small, CompressionInfo.from_array(small)).compression == CompressionType.NONE
+    assert adaptive.compress(large, CompressionInfo.from_array(large)).compression == CompressionType.FLOAT16
+
+
+def test_role_adaptive_compression():
+    adaptive = RoleAdaptiveCompression(
+        gradient=Uniform8BitQuantization(), parameter=Float16Compression(), default=NoCompression()
+    )
+    x = np.random.randn(100).astype(np.float32)
+    grad_info = CompressionInfo.from_array(x, role=TensorRole.GRADIENT)
+    param_info = CompressionInfo.from_array(x, role=TensorRole.PARAMETER)
+    act_info = CompressionInfo.from_array(x, role=TensorRole.ACTIVATION)
+    assert adaptive.compress(x, grad_info).compression == CompressionType.UNIFORM_8BIT
+    assert adaptive.compress(x, param_info).compression == CompressionType.FLOAT16
+    assert adaptive.compress(x, act_info).compression == CompressionType.NONE
+
+
+def test_per_tensor_compression():
+    per_tensor = PerTensorCompression({"a": NoCompression(), "b": BlockwiseQuantization()})
+    x = np.random.randn(100).astype(np.float32)
+    assert per_tensor.compress(x, CompressionInfo.from_array(x, key="a")).compression == CompressionType.NONE
+    assert per_tensor.compress(x, CompressionInfo.from_array(x, key="b")).compression == CompressionType.BLOCKWISE_8BIT
+
+
+async def test_tensor_streaming_roundtrip():
+    originals = [
+        np.random.randn(100_000).astype(np.float32),
+        np.random.randn(10).astype(np.float32),
+        np.random.randn(333, 3).astype(np.float32),
+    ]
+    chunks = []
+    for original in originals:
+        serialized = serialize_tensor(original, CompressionType.FLOAT16)
+        chunks.extend(split_tensor_for_streaming(serialized, chunk_size_bytes=2**16))
+
+    async def stream():
+        for chunk in chunks:
+            yield [chunk]
+
+    restored = await deserialize_tensor_stream(stream())
+    assert len(restored) == len(originals)
+    for orig, rest in zip(originals, restored):
+        assert np.allclose(orig, rest, rtol=1e-3, atol=1e-3)
+
+
+async def test_tensor_streaming_truncated_fails():
+    serialized = serialize_tensor(np.random.randn(100_000).astype(np.float32))
+    chunks = split_tensor_for_streaming(serialized, chunk_size_bytes=2**16)
+
+    async def stream():
+        for chunk in chunks[:-1]:
+            yield [chunk]
+
+    with pytest.raises(ValueError, match="mid-tensor"):
+        await deserialize_tensor_stream(stream())
