@@ -1,0 +1,320 @@
+"""One fault-tolerant butterfly all-reduce round inside a fixed group
+(capability parity: reference hivemind/averaging/allreduce.py).
+
+Each peer reduces the span of the concatenated vector assigned by the load balancer;
+senders stream their parts to every reducer, reducers stream back DELTAS
+(averaged − that sender's input — reference allreduce.py:39: deltas keep precision and
+make a dead reducer equivalent to delta 0). Modes (reference allreduce.py:26-29):
+NODE sends + reduces, CLIENT sends only (firewalled/zero-bandwidth), AUX reduces only
+(e.g. a CPU helper with no gradients of its own)."""
+
+from __future__ import annotations
+
+import asyncio
+from enum import Enum
+from typing import AsyncIterator, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from hivemind_tpu.averaging.partition import (
+    AllreduceException,
+    TensorPartContainer,
+    TensorPartReducer,
+)
+from hivemind_tpu.compression import CompressionBase, NoCompression, deserialize_tensor, serialize_tensor
+from hivemind_tpu.p2p import P2P, P2PContext, PeerID
+from hivemind_tpu.proto import averaging_pb2
+from hivemind_tpu.utils.asyncio_utils import aiter_with_timeout
+from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.timed_storage import get_dht_time
+
+logger = get_logger(__name__)
+
+
+class AveragingMode(Enum):
+    NODE = 0
+    CLIENT = 1
+    AUX = 2
+
+
+class AllReduceRunner:
+    """Runs one allreduce round. The owning averager routes incoming
+    ``rpc_aggregate_part`` streams for this group_id to ``handle_aggregate_stream``.
+
+    :param peer_element_counts: reduction span sizes per peer (load balancer output)
+    :param get_stub: callable (peer_id) -> stub with .rpc_aggregate_part(stream)
+    """
+
+    def __init__(
+        self,
+        *,
+        p2p: P2P,
+        group_id: bytes,
+        tensors: Sequence,
+        ordered_peer_ids: Sequence[PeerID],
+        peer_element_counts: Sequence[int],
+        modes: Sequence[AveragingMode],
+        get_stub,
+        weight: float = 1.0,
+        compression: CompressionBase = NoCompression(),
+        part_size_bytes: int = 2**19,
+        sender_timeout: float = 30.0,
+        reducer_timeout: float = 60.0,
+    ):
+        self.p2p, self.group_id = p2p, group_id
+        self.ordered_peer_ids = tuple(ordered_peer_ids)
+        self.modes = tuple(modes)
+        self.peer_element_counts = tuple(peer_element_counts)
+        self.get_stub = get_stub
+        self.weight = weight
+        self.sender_timeout, self.reducer_timeout = sender_timeout, reducer_timeout
+        self.my_index = self.ordered_peer_ids.index(p2p.peer_id)
+        self.my_mode = self.modes[self.my_index]
+        assert len(self.modes) == len(self.ordered_peer_ids) == len(self.peer_element_counts)
+        for peer_index, (mode, count) in enumerate(zip(self.modes, self.peer_element_counts)):
+            if mode == AveragingMode.CLIENT:
+                assert count == 0, "client-mode peers cannot be assigned reduction work"
+
+        self.sender_ranks: Dict[int, int] = {}  # peer_index -> sender rank
+        for peer_index, mode in enumerate(self.modes):
+            if mode != AveragingMode.AUX:
+                self.sender_ranks[peer_index] = len(self.sender_ranks)
+        self.num_senders = len(self.sender_ranks)
+
+        self.container = TensorPartContainer(
+            tensors, peer_element_counts, compression, part_size_bytes
+        ) if self.my_mode != AveragingMode.AUX else None
+        my_part_shapes = self._span_part_shapes(self.my_index, part_size_bytes)
+        self.reducer = TensorPartReducer(my_part_shapes, self.num_senders)
+        self.compression = compression
+        self.part_size_bytes = part_size_bytes
+        self.banned_senders: set = set()
+        self._sender_last_active: Dict[int, float] = {}
+        self._parts_received: Dict[int, int] = {}  # sender rank -> parts accepted
+        self._finished = asyncio.Event()
+
+    def _span_part_shapes(self, peer_index: int, part_size_bytes: int) -> list:
+        """Part shapes of one peer's reduction span (derivable by every group member
+        from the element counts alone — AUX peers have no container). Uses the shared
+        partitioning rule so sender splits and reducer expectations cannot drift."""
+        from hivemind_tpu.averaging.partition import compute_span_part_sizes
+
+        return [(size,) for size in compute_span_part_sizes(self.peer_element_counts[peer_index], part_size_bytes)]
+
+    # ------------------------------------------------------------------ sending side
+
+    async def run(self) -> AsyncIterator[np.ndarray]:
+        """Send parts to all reducers, reduce own span, yield per-tensor deltas
+        (AUX mode: reduces only, yields nothing)."""
+        communicate_tasks = []
+        if self.my_mode != AveragingMode.AUX:
+            for peer_index, count in enumerate(self.peer_element_counts):
+                if count == 0:
+                    continue
+                if peer_index == self.my_index:
+                    communicate_tasks.append(asyncio.create_task(self._reduce_local_parts()))
+                else:
+                    communicate_tasks.append(
+                        asyncio.create_task(self._communicate_with_peer(peer_index))
+                    )
+        watchdog = asyncio.create_task(self._sender_watchdog()) if self.peer_element_counts[self.my_index] else None
+        try:
+            if self.my_mode == AveragingMode.AUX:
+                await self._wait_all_parts_reduced()
+                return
+            assert self.container is not None
+            async for delta_tensor in self.container.iterate_output_tensors():
+                yield delta_tensor
+        finally:
+            self._finished.set()
+            if watchdog is not None:
+                watchdog.cancel()
+            for task in communicate_tasks:
+                if not task.done():
+                    task.cancel()
+            self.reducer.finalize()
+
+    async def _reduce_local_parts(self) -> None:
+        """Loopback: feed own parts into own reducer without serialization."""
+        assert self.container is not None
+        my_rank = self.sender_ranks[self.my_index]
+        try:
+            for part_index, part in enumerate(self.container.get_raw_input_parts(self.my_index)):
+                self._sender_last_active[my_rank] = get_dht_time()
+                averaged = await self.reducer.accumulate_part(my_rank, part_index, part, self.weight)
+                self.container.register_processed_part(
+                    self.my_index, part_index, averaged - part.astype(np.float32)
+                )
+        except AllreduceException as e:
+            logger.debug(f"local reduction failed: {e}")
+            self.container.register_failed_reducer(self.my_index)
+
+    async def _communicate_with_peer(self, peer_index: int) -> None:
+        """Stream our parts to one reducer and apply the deltas it returns
+        (reference allreduce.py:201-245)."""
+        assert self.container is not None
+        peer_id = self.ordered_peer_ids[peer_index]
+        try:
+            stub = self.get_stub(peer_id)
+
+            async def _requests():
+                first = True
+                async for serialized in self.container.iterate_input_parts_for(peer_index):
+                    yield averaging_pb2.AveragingData(
+                        code=averaging_pb2.PART_DATA,
+                        group_id=self.group_id if first else b"",
+                        tensor_part=serialized,
+                        weight=self.weight,
+                    )
+                    first = False
+
+            part_index = 0
+            stream = stub.rpc_aggregate_part(_requests())
+            # outlast the reducer's own laggard recovery: it may take up to
+            # reducer_timeout to fail a stalled sender and produce our delta
+            per_delta_timeout = self.reducer_timeout + self.sender_timeout
+            async for response in aiter_with_timeout(stream, per_delta_timeout):
+                if response.code != averaging_pb2.PART_DATA:
+                    raise AllreduceException(
+                        f"peer {peer_id} replied {averaging_pb2.MessageCode.Name(response.code)}"
+                    )
+                delta = deserialize_tensor(response.tensor_part)
+                self.container.register_processed_part(peer_index, part_index, delta)
+                part_index += 1
+            if part_index < self.container.num_parts_by_peer[peer_index]:
+                raise AllreduceException(
+                    f"peer {peer_id} closed early: {part_index}/{self.container.num_parts_by_peer[peer_index]} parts"
+                )
+        except (Exception, asyncio.CancelledError) as e:
+            if not isinstance(e, asyncio.CancelledError):
+                logger.warning(f"reducer {peer_id} failed: {e!r}; keeping local values for its parts")
+                self.container.register_failed_reducer(peer_index)
+            else:
+                raise
+
+    # ------------------------------------------------------------------ reducing side
+
+    async def handle_aggregate_stream(
+        self,
+        first_message: averaging_pb2.AveragingData,
+        requests: AsyncIterator[averaging_pb2.AveragingData],
+        context: P2PContext,
+    ) -> AsyncIterator[averaging_pb2.AveragingData]:
+        """Serve one sender's part stream for our reduction span; called by the
+        averager's rpc_aggregate_part once the group_id is matched."""
+        try:
+            sender_peer_index = self.ordered_peer_ids.index(context.remote_id)
+        except ValueError:
+            yield averaging_pb2.AveragingData(code=averaging_pb2.PROTOCOL_VIOLATION)
+            return
+        sender_rank = self.sender_ranks.get(sender_peer_index)
+        if sender_rank is None or sender_rank in self.banned_senders:
+            yield averaging_pb2.AveragingData(code=averaging_pb2.PROTOCOL_VIOLATION)
+            return
+
+        # read EAGERLY on a side task: a sender's liveness must be judged by when its
+        # parts ARRIVE, not by when the (possibly laggard-blocked) reduction loop gets
+        # to them — otherwise one slow sender makes every other sender look stalled
+        arrived: asyncio.Queue = asyncio.Queue()
+
+        async def _reader():
+            try:
+                self._sender_last_active[sender_rank] = get_dht_time()
+                self._parts_received[sender_rank] = 1
+                await arrived.put(first_message)
+                count = 1
+                async for message in requests:
+                    count += 1
+                    self._sender_last_active[sender_rank] = get_dht_time()
+                    self._parts_received[sender_rank] = count
+                    await arrived.put(message)
+            finally:
+                await arrived.put(None)
+
+        reader_task = asyncio.create_task(_reader())
+        part_index = 0
+        try:
+            while True:
+                message = await arrived.get()
+                if message is None:
+                    break
+                if sender_rank in self.banned_senders:
+                    # the watchdog failed this sender; late parts must not leak into
+                    # parts that were already averaged without it
+                    yield averaging_pb2.AveragingData(code=averaging_pb2.CANCELLED)
+                    return
+                part = deserialize_tensor(message.tensor_part)
+                try:
+                    # weight 0.0 is legitimate (zero-weight peers contribute nothing);
+                    # senders always set the field explicitly
+                    averaged = await asyncio.wait_for(
+                        self.reducer.accumulate_part(
+                            sender_rank, part_index, part, float(message.weight)
+                        ),
+                        timeout=self.reducer_timeout,
+                    )
+                except asyncio.TimeoutError:
+                    self._fail_laggards(part_index)
+                    yield averaging_pb2.AveragingData(code=averaging_pb2.CANCELLED)
+                    return
+                delta = averaged - part.astype(np.float32)
+                yield averaging_pb2.AveragingData(
+                    code=averaging_pb2.PART_DATA,
+                    tensor_part=serialize_tensor(delta, self.compression),
+                )
+                part_index += 1
+        except (ConnectionError, asyncio.CancelledError, GeneratorExit):
+            self._ban_sender(sender_rank, "stream interrupted")
+            raise
+        except AllreduceException as e:
+            logger.debug(f"aggregate stream from {context.remote_id} failed: {e}")
+            self._ban_sender(sender_rank, str(e))
+            yield averaging_pb2.AveragingData(code=averaging_pb2.INTERNAL_ERROR)
+            return
+        finally:
+            reader_task.cancel()
+        if part_index < len(self.reducer.part_shapes):
+            self._ban_sender(sender_rank, f"sent only {part_index}/{len(self.reducer.part_shapes)} parts")
+
+    def _ban_sender(self, sender_rank: int, reason: str) -> None:
+        if sender_rank not in self.banned_senders:
+            logger.debug(f"banning sender {sender_rank}: {reason}")
+            self.banned_senders.add(sender_rank)
+            self.reducer.on_sender_failed(sender_rank)
+
+    def _fail_laggards(self, part_index: int) -> None:
+        """A part timed out: fail every sender that has not contributed to it."""
+        state = self.reducer._parts.get(part_index)
+        if state is None:
+            return
+        for rank in range(self.reducer.num_senders):
+            if not state["contributed"][rank] and not self.reducer.sender_failed[rank]:
+                self._ban_sender(rank, f"no part {part_index} within reducer_timeout")
+
+    async def _sender_watchdog(self) -> None:
+        """Fail senders that never open their stream OR stall mid-stream
+        (reference allreduce.py:192-199)."""
+        start_time = get_dht_time()
+        total_parts = len(self.reducer.part_shapes)
+        while not self._finished.is_set():
+            await asyncio.sleep(self.sender_timeout / 4)
+            now = get_dht_time()
+            for peer_index, rank in self.sender_ranks.items():
+                if rank in self.banned_senders:
+                    continue
+                last_active = self._sender_last_active.get(rank)
+                reference_time = last_active if last_active is not None else start_time
+                unfinished = self._parts_received.get(rank, 0) < total_parts
+                if unfinished and now - reference_time > self.sender_timeout:
+                    reason = "never started sending" if last_active is None else "stalled mid-stream"
+                    self._ban_sender(rank, reason)
+
+    async def _wait_all_parts_reduced(self) -> None:
+        """AUX mode: stay alive until every part of our span is reduced."""
+        num_parts = len(self.reducer.part_shapes)
+        for part_index in range(num_parts):
+            state = self.reducer._part_state(part_index)
+            try:
+                await asyncio.wait_for(asyncio.shield(state["future"]), timeout=self.reducer_timeout)
+            except (asyncio.TimeoutError, AllreduceException):
+                self._fail_laggards(part_index)

@@ -1,0 +1,513 @@
+"""DecentralizedAverager: iteratively average tensors with random groups of peers
+(capability parity: reference hivemind/averaging/averager.py).
+
+The reference is an mp.Process with shared-memory tensors; here the averager is an
+asyncio component on the shared loop thread, holding host (numpy) mirrors of the
+tensors under a threading lock. ``step()`` is the sync entrypoint; it returns a
+StepControl whose two-phase trigger lets callers pre-schedule matchmaking before
+gradients are ready (reference averager.py:367-419 + control.py)."""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import hashlib
+import os
+import random
+import threading
+from typing import Any, AsyncIterator, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from hivemind_tpu.averaging.allreduce import AllReduceRunner, AveragingMode
+from hivemind_tpu.averaging.control import AveragingStage, StepControl
+from hivemind_tpu.averaging.group_info import GroupInfo
+from hivemind_tpu.averaging.key_manager import GroupKeyManager
+from hivemind_tpu.averaging.load_balancing import load_balance_peers
+from hivemind_tpu.averaging.matchmaking import Matchmaking, MatchmakingException
+from hivemind_tpu.averaging.partition import AllreduceException, DEFAULT_PART_SIZE_BYTES
+from hivemind_tpu.compression import (
+    CompressionBase,
+    NoCompression,
+    deserialize_tensor,
+    serialize_tensor,
+    split_tensor_for_streaming,
+)
+from hivemind_tpu.compression.base import as_numpy
+from hivemind_tpu.dht import DHT
+from hivemind_tpu.p2p import P2P, P2PContext, PeerID, ServicerBase
+from hivemind_tpu.proto import averaging_pb2, runtime_pb2
+from hivemind_tpu.utils.asyncio_utils import anext_safe, enter_asynchronously
+from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.loop import LoopRunner, get_loop_runner
+from hivemind_tpu.utils.serializer import MSGPackSerializer
+from hivemind_tpu.utils.timed_storage import DHTExpiration, ValueWithExpiration, get_dht_time
+
+logger = get_logger(__name__)
+
+GatheredData = Dict[PeerID, Any]
+
+
+class DecentralizedAverager(ServicerBase):
+    """See module docstring.
+
+    :param averaged_tensors: tensors (numpy or jax) whose values will be averaged;
+        the averager keeps float-preserving numpy mirrors, accessible via get_tensors()
+    :param dht: a running DHT instance for matchmaking and state declaration
+    :param prefix: swarm-unique namespace; peers with the same prefix average together
+    """
+
+    _class_handle_name = "DecentralizedAverager"  # all subclasses share the wire name
+
+    def __init__(
+        self,
+        averaged_tensors: Sequence,
+        dht: DHT,
+        *,
+        prefix: str,
+        start: bool = False,
+        target_group_size: Optional[int] = None,
+        min_group_size: int = 2,
+        initial_group_bits: str = "",
+        min_matchmaking_time: float = 5.0,
+        request_timeout: float = 3.0,
+        allreduce_timeout: Optional[float] = None,
+        sender_timeout: float = 30.0,
+        reducer_timeout: float = 60.0,
+        compression: CompressionBase = NoCompression(),
+        part_size_bytes: int = DEFAULT_PART_SIZE_BYTES,
+        bandwidth: Optional[float] = None,
+        client_mode: bool = False,
+        auxiliary: bool = False,
+        allow_state_sharing: Optional[bool] = None,
+        state_compression: Optional[CompressionBase] = None,
+        declare_state_period: float = 30.0,
+        shutdown_timeout: float = 5.0,
+        loop_runner: Optional[LoopRunner] = None,
+    ):
+        assert "." not in prefix, "prefix may not contain '.'"
+        self.dht = dht
+        self.prefix = prefix
+        self.client_mode, self.auxiliary = client_mode, auxiliary
+        self.mode = (
+            AveragingMode.CLIENT if client_mode else AveragingMode.AUX if auxiliary else AveragingMode.NODE
+        )
+        self.target_group_size, self.min_group_size = target_group_size, min_group_size
+        self.min_matchmaking_time = min_matchmaking_time
+        self.request_timeout, self.allreduce_timeout = request_timeout, allreduce_timeout
+        self.sender_timeout, self.reducer_timeout = sender_timeout, reducer_timeout
+        self.compression, self.part_size_bytes = compression, part_size_bytes
+        self.state_compression = state_compression if state_compression is not None else compression
+        self.bandwidth = bandwidth if bandwidth is not None else (0.0 if client_mode else 1.0e8)
+        self.declare_state_period = declare_state_period
+        self.shutdown_timeout = shutdown_timeout
+
+        self._averaged_tensors: List[np.ndarray] = [np.array(as_numpy(t), copy=True) for t in averaged_tensors]
+        self.lock_averaged_tensors = threading.Lock()
+        self._allow_state_sharing = (
+            allow_state_sharing if allow_state_sharing is not None else not (client_mode or auxiliary)
+        )
+        self._state_sharing_priority = 0.0
+
+        self.schema_hash = self._compute_schema_hash()
+        self._runner = loop_runner if loop_runner is not None else get_loop_runner()
+        self._running_allreduces: Dict[bytes, AllReduceRunner] = {}
+        self._allreduce_registered = asyncio.Condition()  # created lazily on loop? see _setup
+        self._ready = threading.Event()
+        self._shutdown = False
+        self.matchmaking: Optional[Matchmaking] = None
+        self.key_manager: Optional[GroupKeyManager] = None
+        self._declare_state_task: Optional[asyncio.Task] = None
+        self.initial_group_bits = initial_group_bits
+
+        if start:
+            self.run_in_background(await_ready=True)
+
+    # ------------------------------------------------------------------ lifecycle
+
+    def run_in_background(self, await_ready: bool = True, timeout: Optional[float] = None) -> None:
+        future = self._runner.run_coroutine(self._setup(), return_future=True)
+        if await_ready:
+            future.result(timeout)
+
+    async def _setup(self) -> None:
+        if self._ready.is_set():
+            return
+        self.p2p: P2P = self.dht.node.p2p
+        self.peer_id: PeerID = self.p2p.peer_id
+        self._allreduce_registered = asyncio.Condition()
+        self.key_manager = GroupKeyManager(
+            self.dht, self.prefix, self.initial_group_bits, self.target_group_size
+        )
+        self.matchmaking = Matchmaking(
+            self.p2p,
+            self.key_manager,
+            self._get_peer_stub,
+            schema_hash=self.schema_hash,
+            target_group_size=self.target_group_size,
+            min_group_size=self.min_group_size,
+            min_matchmaking_time=self.min_matchmaking_time,
+            request_timeout=self.request_timeout,
+            client_mode=self.client_mode,
+        )
+        await self.add_p2p_handlers(self.p2p, namespace=self.prefix)
+        if self._allow_state_sharing:
+            self._declare_state_task = asyncio.create_task(self._declare_for_download_periodically())
+        self._ready.set()
+
+    @property
+    def is_alive(self) -> bool:
+        return self._ready.is_set() and not self._shutdown
+
+    @property
+    def allow_state_sharing(self) -> bool:
+        return self._allow_state_sharing
+
+    @allow_state_sharing.setter
+    def allow_state_sharing(self, value: bool) -> None:
+        self._allow_state_sharing = value
+        if value and self._ready.is_set() and not self._shutdown:
+            # the declare loop may never have been started (e.g. sharing was off at
+            # construction); without it peers can never discover our state
+            async def _ensure_declare_task():
+                if self._declare_state_task is None or self._declare_state_task.done():
+                    self._declare_state_task = asyncio.create_task(
+                        self._declare_for_download_periodically()
+                    )
+
+            self._runner.run_coroutine(_ensure_declare_task(), return_future=True)
+
+    @property
+    def state_sharing_priority(self) -> float:
+        return self._state_sharing_priority
+
+    @state_sharing_priority.setter
+    def state_sharing_priority(self, value: float) -> None:
+        self._state_sharing_priority = value
+
+    def shutdown(self) -> None:
+        if self._shutdown or not self._ready.is_set():
+            self._shutdown = True
+            return
+        self._shutdown = True
+
+        async def _teardown():
+            if self._declare_state_task is not None:
+                self._declare_state_task.cancel()
+            with contextlib.suppress(Exception):
+                await self.remove_p2p_handlers(self.p2p, namespace=self.prefix)
+
+        with contextlib.suppress(Exception):
+            self._runner.run_coroutine(_teardown(), return_future=True).result(self.shutdown_timeout)
+
+    def __enter__(self):
+        if not self._ready.is_set():
+            self.run_in_background(await_ready=True)
+        return self
+
+    def __exit__(self, *args):
+        self.shutdown()
+
+    def __del__(self):
+        with contextlib.suppress(Exception):
+            if self.is_alive:
+                self.shutdown()
+
+    # ------------------------------------------------------------------ tensors
+
+    @contextlib.contextmanager
+    def get_tensors(self):
+        """Host-side access to the averaged tensors (mutable, lock-guarded —
+        reference averager.py:564-572)."""
+        with self.lock_averaged_tensors:
+            yield self._averaged_tensors
+
+    def _compute_schema_hash(self) -> str:
+        schema = [[list(t.shape), str(t.dtype)] for t in self._averaged_tensors]
+        payload = MSGPackSerializer.dumps([schema, type(self.compression).__name__, "v1"])
+        return hashlib.sha256(payload).hexdigest()[:32]
+
+    def _get_peer_stub(self, peer_id: PeerID):
+        return type(self).get_stub(self.p2p, peer_id, namespace=self.prefix)
+
+    # ------------------------------------------------------------------ stepping
+
+    def step(
+        self,
+        gather: Any = None,
+        *,
+        weight: Optional[float] = None,
+        scheduled_time: Optional[DHTExpiration] = None,
+        timeout: Optional[float] = None,
+        allow_retries: bool = True,
+        require_trigger: bool = False,
+        wait: bool = True,
+    ) -> Union[Optional[GatheredData], StepControl]:
+        """Try to average tensors with a group of peers.
+
+        :param gather: opaque metadata exchanged with groupmates (returned as a dict)
+        :param require_trigger: two-phase mode — matchmaking may start now, but the
+            all-reduce waits for control.allow_allreduce()
+        :param wait: block and return gathered data; else return the StepControl
+        """
+        if self.mode == AveragingMode.AUX and weight is not None and weight != 0:
+            logger.warning("auxiliary peers always have weight 0; ignoring")
+            weight = 0.0
+        weight = weight if weight is not None else float(self.mode != AveragingMode.AUX)
+        now = get_dht_time()
+        control = StepControl(
+            scheduled_time=scheduled_time if scheduled_time is not None else now + self.min_matchmaking_time,
+            deadline=now + timeout if timeout is not None else None,
+            allow_retries=allow_retries,
+            weight=weight,
+            data_for_gather=MSGPackSerializer.dumps([self.bandwidth, self.mode.value, gather]),
+        )
+        if not require_trigger:
+            control.allow_allreduce()
+        self._runner.run_coroutine(self._step(control), return_future=True)
+        return control.result(timeout) if wait else control
+
+    async def _step(self, control: StepControl) -> None:
+        try:
+            while not control.done():
+                try:
+                    control.stage = AveragingStage.LOOKING_FOR_GROUP
+                    assert self.matchmaking is not None
+                    group_info = await self.matchmaking.look_for_group(
+                        data_for_gather=control.data_for_gather,
+                        scheduled_time=control.scheduled_time,
+                        timeout=control.get_timeout(),
+                    )
+                    if control.cancelled:
+                        return
+                    if group_info is None:
+                        raise MatchmakingException("could not find a group this attempt")
+                    control.stage = AveragingStage.AWAITING_TRIGGER
+                    await control.wait_for_trigger()
+                    if control.cancelled:
+                        return
+                    control.began_allreduce = True
+                    control.stage = AveragingStage.RUNNING_ALLREDUCE
+                    gathered = await self._aggregate_with_group(group_info, control.weight)
+                    control.set_result(gathered)
+                    return
+                except (
+                    MatchmakingException,
+                    AllreduceException,
+                    AssertionError,
+                    asyncio.TimeoutError,
+                    ConnectionError,
+                ) as e:
+                    deadline_passed = control.deadline is not None and get_dht_time() >= control.deadline
+                    if not control.allow_retries or deadline_passed:
+                        logger.info(f"averaging step failed: {e!r}")
+                        control.set_exception(e)
+                        return
+                    logger.debug(f"averaging attempt failed: {e!r}; retrying")
+                    # rescheduled attempt: aim a fresh matchmaking window
+                    control.reset_for_retry(get_dht_time() + self.min_matchmaking_time)
+        except asyncio.CancelledError:
+            control.cancel()
+            raise
+        except Exception as e:
+            control.set_exception(e)
+
+    async def _aggregate_with_group(self, group_info: GroupInfo, weight: float) -> GatheredData:
+        """Decode gathered metadata, balance load, run the all-reduce, apply deltas
+        (reference averager.py:514-562)."""
+        bandwidths, modes, user_gathered = [], [], {}
+        for peer_id, blob in zip(group_info.peer_ids, group_info.gathered):
+            peer_bandwidth, peer_mode, user_data = MSGPackSerializer.loads(blob)
+            bandwidths.append(float(peer_bandwidth))
+            modes.append(AveragingMode(peer_mode))
+            user_gathered[peer_id] = user_data
+
+        with self.lock_averaged_tensors:
+            total_elements = sum(int(np.prod(t.shape)) for t in self._averaged_tensors)
+        reducer_bandwidths = [
+            bandwidth if mode != AveragingMode.CLIENT else 0.0
+            for bandwidth, mode in zip(bandwidths, modes)
+        ]
+        peer_element_counts = load_balance_peers(total_elements, reducer_bandwidths)
+
+        runner = self._make_allreduce_runner(group_info, peer_element_counts, modes, weight)
+        async with self._allreduce_registered:
+            self._running_allreduces[group_info.group_id] = runner
+            self._allreduce_registered.notify_all()
+        try:
+            iterator = runner.run()
+            if self.allreduce_timeout is not None:
+                from hivemind_tpu.utils.asyncio_utils import aiter_with_timeout
+
+                iterator = aiter_with_timeout(iterator, self.allreduce_timeout)
+            index = 0
+            async for delta in iterator:
+                await self._apply_delta(index, delta)
+                index += 1
+            if runner.container is not None and runner.container.failed_size:
+                logger.warning(
+                    f"allreduce degraded: {runner.container.failed_size}/{runner.container.total_elements} "
+                    f"elements kept local values (failed reducers)"
+                )
+            return user_gathered
+        finally:
+            self._running_allreduces.pop(group_info.group_id, None)
+
+    def _make_allreduce_runner(
+        self,
+        group_info: GroupInfo,
+        peer_element_counts: Sequence[int],
+        modes: Sequence[AveragingMode],
+        weight: float,
+    ) -> AllReduceRunner:
+        """Overridable factory — the designed-in fault-injection seam (the reference's
+        tests override the equivalent to inject mid-stream failures, SURVEY §4)."""
+        return AllReduceRunner(
+            p2p=self.p2p,
+            group_id=group_info.group_id,
+            tensors=self._snapshot_tensors(),
+            ordered_peer_ids=group_info.peer_ids,
+            peer_element_counts=peer_element_counts,
+            modes=modes,
+            get_stub=self._get_peer_stub,
+            weight=weight,
+            compression=self.compression,
+            part_size_bytes=self.part_size_bytes,
+            sender_timeout=self.sender_timeout,
+            reducer_timeout=self.reducer_timeout,
+        )
+
+    def _snapshot_tensors(self) -> List[np.ndarray]:
+        with self.lock_averaged_tensors:
+            return [t.copy() for t in self._averaged_tensors]
+
+    async def _apply_delta(self, index: int, delta: np.ndarray) -> None:
+        async with enter_asynchronously(self.lock_averaged_tensors):
+            tensor = self._averaged_tensors[index]
+            tensor += delta.astype(tensor.dtype, copy=False)
+
+    # ------------------------------------------------------------------ RPCs
+
+    async def rpc_join_group(
+        self, request: averaging_pb2.JoinRequest, context: P2PContext
+    ) -> AsyncIterator[averaging_pb2.MessageFromLeader]:
+        assert self.matchmaking is not None
+        async for message in self.matchmaking.rpc_join_group(request, context):
+            yield message
+
+    async def rpc_aggregate_part(
+        self, requests: AsyncIterator[averaging_pb2.AveragingData], context: P2PContext
+    ) -> AsyncIterator[averaging_pb2.AveragingData]:
+        """Route one sender's part stream to the matching allreduce runner; tolerates
+        the sender arriving before our own group registration (the race at reference
+        averager.py:585-590)."""
+        first = await anext_safe(requests.__aiter__() if hasattr(requests, "__aiter__") else requests)
+        if not isinstance(first, averaging_pb2.AveragingData):
+            return
+        runner = await self._find_runner(first.group_id)
+        if runner is None:
+            yield averaging_pb2.AveragingData(code=averaging_pb2.PROTOCOL_VIOLATION)
+            return
+        async for message in runner.handle_aggregate_stream(first, requests, context):
+            yield message
+
+    async def _find_runner(self, group_id: bytes, timeout: Optional[float] = None) -> Optional[AllReduceRunner]:
+        timeout = timeout if timeout is not None else self.request_timeout * 2
+        deadline = get_dht_time() + timeout
+        async with self._allreduce_registered:
+            while group_id not in self._running_allreduces:
+                remaining = deadline - get_dht_time()
+                if remaining <= 0:
+                    return None
+                try:
+                    await asyncio.wait_for(self._allreduce_registered.wait(), timeout=remaining)
+                except asyncio.TimeoutError:
+                    return None
+            return self._running_allreduces[group_id]
+
+    # ------------------------------------------------------------------ state sharing
+
+    async def _get_current_state(self) -> Tuple[Any, List[np.ndarray]]:
+        """Overridable: the state downloadable by joining peers. Default: no metadata,
+        the averaged tensors (reference get_current_state)."""
+        return None, self._snapshot_tensors()
+
+    async def rpc_download_state(
+        self, request: averaging_pb2.DownloadRequest, context: P2PContext
+    ) -> AsyncIterator[averaging_pb2.DownloadData]:
+        """Stream (metadata, tensors) to a joining peer (reference averager.py:628-651)."""
+        if not self._allow_state_sharing:
+            return
+        metadata, tensors = await self._get_current_state()
+        yield averaging_pb2.DownloadData(metadata=MSGPackSerializer.dumps(metadata))
+        for tensor in tensors:
+            serialized = serialize_tensor(tensor, self.state_compression)
+            for chunk in split_tensor_for_streaming(serialized, 2**20):
+                yield averaging_pb2.DownloadData(tensor_part=chunk)
+
+    async def _load_state_from_peers_async(self, timeout: Optional[float] = None) -> Optional[Tuple[Any, List[np.ndarray]]]:
+        key = f"{self.prefix}.all_averagers"
+        result = await self.dht.node.get(key, latest=True)
+        candidates = []
+        if result is not None and isinstance(result.value, dict):
+            for subkey, entry in result.value.items():
+                try:
+                    peer_id = PeerID.from_base58(subkey)
+                    priority = entry.value
+                    if peer_id != self.peer_id and isinstance(priority, (int, float, list, tuple)):
+                        candidates.append((priority, random.random(), peer_id))
+                except Exception:
+                    continue
+        candidates.sort(reverse=True)
+        for _priority, _jitter, peer_id in candidates:
+            try:
+                stub = self._get_peer_stub(peer_id)
+                stream = stub.rpc_download_state(averaging_pb2.DownloadRequest(), timeout=timeout or 60.0)
+                holder: Dict[str, Any] = {}
+
+                async def _tensor_parts():
+                    async for message in stream:
+                        if message.metadata and "metadata" not in holder:
+                            holder["metadata"] = MSGPackSerializer.loads(message.metadata)
+                        if message.HasField("tensor_part"):
+                            yield [message.tensor_part]
+
+                from hivemind_tpu.compression import deserialize_tensor_stream
+
+                tensors = await deserialize_tensor_stream(_tensor_parts())
+                if "metadata" in holder or tensors:
+                    logger.info(f"downloaded state from {peer_id}")
+                    return holder.get("metadata"), tensors
+            except Exception as e:
+                logger.debug(f"state download from {peer_id} failed: {e!r}")
+        logger.warning("could not download state from any peer")
+        return None
+
+    def load_state_from_peers(self, timeout: Optional[float] = None, wait: bool = True):
+        """Fetch (metadata, tensors) from the best-priority peer sharing state."""
+        future = self._runner.run_coroutine(self._load_state_from_peers_async(timeout), return_future=True)
+        return future.result(timeout) if wait else future
+
+    async def _declare_for_download_periodically(self) -> None:
+        key = f"{self.prefix}.all_averagers"
+        while True:
+            if self._allow_state_sharing:
+                with contextlib.suppress(Exception):
+                    await self.dht.node.store(
+                        key,
+                        value=self._state_sharing_priority,
+                        expiration_time=get_dht_time() + self.declare_state_period * 2,
+                        subkey=self.peer_id.to_base58(),
+                    )
+            await asyncio.sleep(self.declare_state_period)
+
+    def get_group_bits(self) -> str:
+        assert self.key_manager is not None
+        return self.key_manager.group_bits
+
+    def set_group_bits(self, bits: str) -> None:
+        assert self.key_manager is not None
+        self.key_manager.group_bits = bits
+
+    def __repr__(self):
+        return f"{type(self).__name__}(prefix={self.prefix!r}, mode={self.mode.name}, alive={self.is_alive})"

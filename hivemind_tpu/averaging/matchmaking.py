@@ -1,0 +1,332 @@
+"""Decentralized group formation (capability parity: reference
+hivemind/averaging/matchmaking.py).
+
+Every averager looking for a group declares itself in the DHT with an expiration (its
+step deadline). Peers always request to join the declared averager with the EARLIEST
+expiration below their own — so the join graph is a DAG and the earliest-expiring peer
+becomes the leader. A leader assembles its group when full or when its own deadline
+arrives; an averager that itself got accepted elsewhere disbands its followers with a
+redirect to its new leader (suggested_leader). The documented deadlock (two peers
+waiting on each other through a chain) is broken by ``request_timeout`` on the first
+response (reference matchmaking.py:29-35)."""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import os
+import random
+from typing import AsyncIterator, Dict, Optional, Tuple
+
+from hivemind_tpu.averaging.group_info import GroupInfo
+from hivemind_tpu.averaging.key_manager import GroupKeyManager
+from hivemind_tpu.p2p import P2P, P2PContext, P2PHandlerError, PeerID
+from hivemind_tpu.proto import averaging_pb2
+from hivemind_tpu.utils.asyncio_utils import anext_safe, cancel_and_wait
+from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.timed_storage import DHTExpiration, get_dht_time
+
+logger = get_logger(__name__)
+
+
+class MatchmakingException(Exception):
+    pass
+
+
+class Matchmaking:
+    """One per averager; drives both the follower side (look_for_group →
+    request-join) and the leader side (rpc_join_group → assemble)."""
+
+    def __init__(
+        self,
+        p2p: P2P,
+        key_manager: GroupKeyManager,
+        get_stub,  # callable(peer_id) -> averager stub (for rpc_join_group)
+        *,
+        schema_hash: str,
+        target_group_size: Optional[int],
+        min_group_size: int = 2,
+        min_matchmaking_time: float = 5.0,
+        request_timeout: float = 3.0,
+        client_mode: bool = False,
+    ):
+        self.p2p = p2p
+        self.peer_id = p2p.peer_id
+        self.key_manager = key_manager
+        self.get_stub = get_stub
+        self.schema_hash = schema_hash
+        self.target_group_size = target_group_size
+        self.min_group_size = min_group_size
+        self.min_matchmaking_time = min_matchmaking_time
+        self.request_timeout = request_timeout
+        self.client_mode = client_mode
+
+        self.lock_looking_for_group = asyncio.Lock()
+        self.looking_for_group = False
+        self.declared_expiration_time: DHTExpiration = -float("inf")
+        self.current_leader: Optional[PeerID] = None
+        # follower peer_id -> (JoinRequest, outbox queue for BEGIN/DISBAND messages)
+        self.current_followers: Dict[PeerID, Tuple[averaging_pb2.JoinRequest, asyncio.Queue]] = {}
+        self.data_for_gather: bytes = b""
+        self.assembled_group: Optional[GroupInfo] = None
+        self._tried_leaders: set = set()
+
+    @property
+    def is_looking_for_group(self) -> bool:
+        return self.looking_for_group
+
+    # ------------------------------------------------------------------ follower side
+
+    async def look_for_group(
+        self, *, data_for_gather: bytes, scheduled_time: Optional[DHTExpiration] = None, timeout: Optional[float] = None
+    ) -> Optional[GroupInfo]:
+        """Search until a group assembles or the deadline passes. Returns None if no
+        group could be formed this attempt."""
+        if self.lock_looking_for_group.locked():
+            logger.debug("another look_for_group is in progress; waiting")
+        async with self.lock_looking_for_group:
+            self.looking_for_group = True
+            self.data_for_gather = data_for_gather
+            self.assembled_group = None
+            self._tried_leaders.clear()
+            now = get_dht_time()
+            self.declared_expiration_time = max(
+                scheduled_time if scheduled_time is not None else now + self.min_matchmaking_time,
+                now + 1e-2,
+            )
+            if timeout is not None:
+                self.declared_expiration_time = min(self.declared_expiration_time, now + timeout)
+            declare_task = None
+            if not self.client_mode:
+                declare_task = asyncio.create_task(self._declare_periodically())
+            try:
+                return await self._search_until_deadline()
+            finally:
+                self.looking_for_group = False
+                self.current_leader = None
+                if declare_task is not None:
+                    await cancel_and_wait(declare_task)
+                    with contextlib.suppress(Exception):
+                        await self.key_manager.declare_averager(
+                            self.key_manager.current_key, self.peer_id, get_dht_time(), looking_for_group=False
+                        )
+                if self.current_followers and self.assembled_group is None:
+                    self._disband_followers(suggested_leader=None)
+
+    async def _declare_periodically(self) -> None:
+        key = self.key_manager.current_key
+        while True:
+            with contextlib.suppress(Exception):
+                await self.key_manager.declare_averager(key, self.peer_id, self.declared_expiration_time)
+            remaining = self.declared_expiration_time - get_dht_time()
+            if remaining <= 0:
+                return
+            await asyncio.sleep(max(remaining / 2, 0.5))
+
+    async def _search_until_deadline(self) -> Optional[GroupInfo]:
+        while get_dht_time() < self.declared_expiration_time:
+            if self.assembled_group is not None:
+                return self.assembled_group  # a full group assembled around us
+            leader = await self._find_next_leader()
+            if self.assembled_group is not None:
+                return self.assembled_group
+            if leader is not None:
+                group = await self._request_join_group(leader)
+                if group is not None:
+                    return group
+                continue
+            remaining = self.declared_expiration_time - get_dht_time()
+            if remaining > 0:
+                await asyncio.sleep(min(remaining, self.request_timeout / 2 + random.random() * 0.2))
+        # the group may have assembled (full-group path) during the final sleep
+        if self.assembled_group is not None:
+            return self.assembled_group
+        # our deadline arrived: we lead whoever joined us (if enough), else give up
+        if len(self.current_followers) + 1 >= self.min_group_size:
+            return self._leader_assemble_group()
+        await self.key_manager.update_key_on_not_enough_peers()
+        return None
+
+    async def _find_next_leader(self) -> Optional[PeerID]:
+        """The declared averager with the earliest expiration strictly before ours
+        (ties broken by peer id) that we haven't already tried this round."""
+        try:
+            candidates = await self.key_manager.get_averagers(self.key_manager.current_key)
+        except Exception as e:
+            logger.debug(f"could not fetch potential leaders: {e!r}")
+            return None
+        now = get_dht_time()
+        best: Optional[Tuple[DHTExpiration, PeerID]] = None
+        for peer_id, expiration in candidates:
+            if peer_id == self.peer_id or peer_id in self._tried_leaders:
+                continue
+            if expiration <= now or expiration >= self.declared_expiration_time:
+                continue  # stale, or they should be joining us instead
+            if best is None or (expiration, peer_id) < best:
+                best = (expiration, peer_id)
+        return best[1] if best is not None else None
+
+    async def _request_join_group(self, leader: PeerID) -> Optional[GroupInfo]:
+        """Stream rpc_join_group to a (chain of) leader(s); follows suggested_leader
+        redirects (reference matchmaking.py:178-252)."""
+        visited_chain: set = set()
+        current: Optional[PeerID] = leader
+        while current is not None and current not in visited_chain and get_dht_time() < self.declared_expiration_time:
+            visited_chain.add(current)
+            self._tried_leaders.add(current)
+            group = None
+            suggested = None
+            try:
+                group, suggested = await self._request_join_one(current)
+            except (P2PHandlerError, ConnectionError, asyncio.TimeoutError, OSError) as e:
+                logger.debug(f"join request to {current} failed: {e!r}")
+            if group is not None:
+                return group
+            current = suggested
+        return None
+
+    async def _request_join_one(self, leader: PeerID):
+        stream = None
+        try:
+            stub = self.get_stub(leader)
+            request = averaging_pb2.JoinRequest(
+                group_key=self.key_manager.current_key.encode(),
+                expiration=self.declared_expiration_time,
+                gather=self.data_for_gather,
+                client_mode=self.client_mode,
+                schema_hash=self.schema_hash,
+            )
+            stream = stub.rpc_join_group(request).__aiter__()
+            first = await asyncio.wait_for(anext_safe(stream), timeout=self.request_timeout)
+            if not isinstance(first, averaging_pb2.MessageFromLeader):
+                return None, None
+            if first.code == averaging_pb2.GROUP_DISBANDED:
+                return None, PeerID(first.suggested_leader) if first.suggested_leader else None
+            if first.code != averaging_pb2.ACCEPTED:
+                logger.debug(f"{leader} rejected us: {averaging_pb2.MessageCode.Name(first.code)}")
+                return None, None
+
+            # accepted: we are now a follower — disband our own would-be group
+            self.current_leader = leader
+            if self.current_followers:
+                self._disband_followers(suggested_leader=leader)
+            # the leader must answer by (its expiration ≤ ours) + grace
+            deadline = self.declared_expiration_time - get_dht_time() + self.request_timeout * 2
+            second = await asyncio.wait_for(anext_safe(stream), timeout=max(deadline, self.request_timeout))
+            if not isinstance(second, averaging_pb2.MessageFromLeader):
+                return None, None
+            if second.code == averaging_pb2.BEGIN_ALLREDUCE:
+                group = GroupInfo(
+                    group_id=second.group_id,
+                    peer_ids=tuple(PeerID(pid) for pid in second.ordered_peer_ids),
+                    gathered=tuple(second.gathered),
+                )
+                if self.peer_id not in group:
+                    raise MatchmakingException(f"leader {leader} assembled a group without us")
+                await self.key_manager.update_key_on_group_assembled(group)
+                return group, None
+            if second.code == averaging_pb2.GROUP_DISBANDED:
+                return None, PeerID(second.suggested_leader) if second.suggested_leader else None
+            return None, None
+        finally:
+            self.current_leader = None
+            if stream is not None:
+                with contextlib.suppress(Exception):
+                    await stream.aclose()
+
+    # ------------------------------------------------------------------ leader side
+
+    async def rpc_join_group(
+        self, request: averaging_pb2.JoinRequest, context: P2PContext
+    ) -> AsyncIterator[averaging_pb2.MessageFromLeader]:
+        """Serve a follower's join request: ACCEPTED now, BEGIN_ALLREDUCE /
+        GROUP_DISBANDED later (reference matchmaking.py:262-332)."""
+        reject = self._check_join_request(request, context)
+        if reject is not None:
+            yield reject
+            return
+        outbox: asyncio.Queue = asyncio.Queue()
+        self.current_followers[context.remote_id] = (request, outbox)
+        try:
+            yield averaging_pb2.MessageFromLeader(code=averaging_pb2.ACCEPTED)
+            if (
+                self.target_group_size is not None
+                and len(self.current_followers) + 1 >= self.target_group_size
+                and self.current_leader is None
+                and self.assembled_group is None
+            ):
+                self._leader_assemble_group()  # group is full: begin early
+            timeout = self.declared_expiration_time - get_dht_time() + self.request_timeout * 2
+            try:
+                message = await asyncio.wait_for(outbox.get(), timeout=max(timeout, self.request_timeout))
+            except asyncio.TimeoutError:
+                message = averaging_pb2.MessageFromLeader(code=averaging_pb2.GROUP_DISBANDED)
+            yield message
+        finally:
+            self.current_followers.pop(context.remote_id, None)
+
+    def _check_join_request(
+        self, request: averaging_pb2.JoinRequest, context: P2PContext
+    ) -> Optional[averaging_pb2.MessageFromLeader]:
+        """The nine rejection reasons (reference matchmaking.py:334-369)."""
+        code = None
+        suggested = b""
+        now = get_dht_time()
+        if not self.looking_for_group or self.assembled_group is not None:
+            code = averaging_pb2.REJECT_NOT_LOOKING_FOR_GROUP
+        elif self.client_mode:
+            code = averaging_pb2.REJECT_REQUEST_TO_CLIENT
+        elif request.group_key != self.key_manager.current_key.encode():
+            code = averaging_pb2.REJECT_WRONG_GROUP_KEY
+        elif request.schema_hash != self.schema_hash:
+            code = averaging_pb2.PROTOCOL_VIOLATION
+        elif self.current_leader is not None:
+            code = averaging_pb2.GROUP_DISBANDED
+            suggested = self.current_leader.to_bytes()
+        elif request.expiration <= now:
+            code = averaging_pb2.REJECT_EXPIRED
+        elif request.expiration < self.declared_expiration_time:
+            # their deadline is earlier: they should lead, not follow
+            code = averaging_pb2.REJECT_WRONG_TIME
+        elif context.remote_id == self.peer_id or context.remote_id in self.current_followers:
+            code = averaging_pb2.REJECT_DUPLICATE_PEER_ID
+        elif self.target_group_size is not None and len(self.current_followers) + 1 >= self.target_group_size:
+            code = averaging_pb2.REJECT_GROUP_IS_FULL
+        if code is None:
+            return None
+        return averaging_pb2.MessageFromLeader(code=code, suggested_leader=suggested)
+
+    def _leader_assemble_group(self) -> GroupInfo:
+        """Assemble self + current followers into a group and notify everyone
+        (reference matchmaking.py:371-406)."""
+        group_id = os.urandom(16)
+        members = [self.peer_id, *self.current_followers.keys()]
+        rng = random.Random(group_id)
+        rng.shuffle(members)
+        gathered = []
+        for member in members:
+            if member == self.peer_id:
+                gathered.append(self.data_for_gather)
+            else:
+                gathered.append(self.current_followers[member][0].gather)
+        group = GroupInfo(group_id, tuple(members), tuple(gathered))
+        self.assembled_group = group
+        message = averaging_pb2.MessageFromLeader(
+            code=averaging_pb2.BEGIN_ALLREDUCE,
+            group_id=group_id,
+            ordered_peer_ids=[pid.to_bytes() for pid in members],
+            gathered=list(gathered),
+        )
+        for _request, outbox in self.current_followers.values():
+            outbox.put_nowait(message)
+        asyncio.ensure_future(self.key_manager.update_key_on_group_assembled(group))
+        logger.debug(f"assembled group of {len(members)} (leader={self.peer_id})")
+        return group
+
+    def _disband_followers(self, suggested_leader: Optional[PeerID]) -> None:
+        message = averaging_pb2.MessageFromLeader(
+            code=averaging_pb2.GROUP_DISBANDED,
+            suggested_leader=suggested_leader.to_bytes() if suggested_leader else b"",
+        )
+        for _request, outbox in self.current_followers.values():
+            outbox.put_nowait(message)

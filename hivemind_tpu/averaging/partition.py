@@ -1,0 +1,249 @@
+"""Tensor partitioning for butterfly all-reduce (capability parity: reference
+hivemind/averaging/partition.py).
+
+``TensorPartContainer`` flattens a tensor list into one logical stream, slices it
+into per-peer spans (element counts from the load balancer) and further into parts of
+at most ``part_size_bytes``; compression runs in the shared executor with bounded
+prefetch. ``TensorPartReducer`` accumulates incoming parts for the span this peer
+reduces, with weighted averaging and denominator shrinking when senders fail."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from hivemind_tpu.compression import CompressionBase, CompressionInfo, NoCompression, deserialize_tensor, serialize_tensor
+from hivemind_tpu.compression.base import as_numpy
+from hivemind_tpu.proto import runtime_pb2
+from hivemind_tpu.utils.asyncio_utils import amap_in_executor, as_aiter
+from hivemind_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+DEFAULT_PART_SIZE_BYTES = 2**19  # 512 KiB pre-compression (reference partition.py:17)
+
+
+def compute_span_part_sizes(element_count: int, part_size_bytes: int) -> List[int]:
+    """Split one peer's reduction span into part sizes. THE single source of truth for
+    part boundaries — senders (TensorPartContainer) and reducers (incl. AUX peers with
+    no container) must agree byte-for-byte. Parts travel as fp32."""
+    part_elements = max(1, part_size_bytes // 4)
+    sizes = []
+    remaining = element_count
+    while remaining > 0:
+        sizes.append(min(part_elements, remaining))
+        remaining -= sizes[-1]
+    return sizes
+
+
+class AllreduceException(RuntimeError):
+    pass
+
+
+class TensorPartContainer:
+    """Splits tensors into per-peer parts and reassembles processed deltas.
+
+    :param tensors: the local tensors (numpy or jax; flattened copy is taken in fp32)
+    :param peer_element_counts: elements assigned to each peer (sums to total numel)
+    """
+
+    def __init__(
+        self,
+        tensors: Sequence,
+        peer_element_counts: Sequence[int],
+        compression: CompressionBase = NoCompression(),
+        part_size_bytes: int = DEFAULT_PART_SIZE_BYTES,
+        tensor_infos: Optional[Sequence[CompressionInfo]] = None,
+        prefetch: int = 4,
+    ):
+        self.tensors = [as_numpy(t) for t in tensors]
+        self.peer_element_counts = tuple(peer_element_counts)
+        self.compression = compression
+        self.part_size_elements = max(1, part_size_bytes // 4)  # parts travel as fp32
+        self.tensor_infos = tensor_infos
+        total = sum(int(np.prod(t.shape)) for t in self.tensors)
+        assert sum(peer_element_counts) == total, (sum(peer_element_counts), total)
+        self.total_elements = total
+
+        self._flat = np.concatenate([t.reshape(-1).astype(np.float32) for t in self.tensors]) if total else np.zeros(0, np.float32)
+        # per-peer list of (start, stop) part spans in the flat stream
+        self.parts_by_peer: List[List[Tuple[int, int]]] = []
+        offset = 0
+        for count in self.peer_element_counts:
+            spans = []
+            for size in compute_span_part_sizes(count, part_size_bytes):
+                spans.append((offset, offset + size))
+                offset += size
+            self.parts_by_peer.append(spans)
+        self.num_parts_by_peer = tuple(len(spans) for spans in self.parts_by_peer)
+
+        self._delta = np.zeros_like(self._flat)
+        self._part_ready: Dict[Tuple[int, int], asyncio.Event] = {}
+        self._peer_failed = [False] * len(self.peer_element_counts)
+        self.failed_size = 0
+        self._finished = asyncio.Event()
+
+    def get_raw_input_parts(self, peer_index: int) -> List[np.ndarray]:
+        return [self._flat[start:stop] for start, stop in self.parts_by_peer[peer_index]]
+
+    async def iterate_input_parts_for(self, peer_index: int) -> AsyncIterator[runtime_pb2.Tensor]:
+        """Serialized parts destined for one peer; compression happens in the shared
+        thread pool with prefetch (reference partition.py:104-112)."""
+        parts = self.get_raw_input_parts(peer_index)
+
+        def _compress(part: np.ndarray) -> runtime_pb2.Tensor:
+            return serialize_tensor(part, self.compression)
+
+        async for serialized in amap_in_executor(_compress, as_aiter(*parts), max_prefetch=4):
+            yield serialized
+
+    def register_processed_part(self, peer_index: int, part_index: int, delta_part: np.ndarray) -> None:
+        """Store the delta (averaged − input) for one part."""
+        start, stop = self.parts_by_peer[peer_index][part_index]
+        expected = stop - start
+        if delta_part.size != expected:
+            raise AllreduceException(
+                f"part size mismatch from peer {peer_index}: got {delta_part.size}, expected {expected}"
+            )
+        self._delta[start:stop] = delta_part.reshape(-1)
+        self._mark_ready(peer_index, part_index)
+
+    def register_failed_reducer(self, peer_index: int) -> None:
+        """A reducer died: its unprocessed parts keep the local value (delta = 0)
+        and count toward failed_size (reference partition.py:128-136)."""
+        if self._peer_failed[peer_index]:
+            return
+        self._peer_failed[peer_index] = True
+        for part_index, (start, stop) in enumerate(self.parts_by_peer[peer_index]):
+            key = (peer_index, part_index)
+            event = self._part_ready.get(key)
+            if event is None or not event.is_set():
+                self.failed_size += stop - start
+                self._mark_ready(peer_index, part_index)
+
+    def _mark_ready(self, peer_index: int, part_index: int) -> None:
+        key = (peer_index, part_index)
+        event = self._part_ready.setdefault(key, asyncio.Event())
+        event.set()
+
+    async def _wait_part(self, peer_index: int, part_index: int) -> None:
+        key = (peer_index, part_index)
+        event = self._part_ready.setdefault(key, asyncio.Event())
+        await event.wait()
+
+    async def iterate_output_tensors(self) -> AsyncIterator[np.ndarray]:
+        """Yield per-tensor DELTAS (float32, original shape) as soon as all parts
+        covering each tensor have arrived (reference partition.py:138-160)."""
+        # map flat offsets back to (peer, part) completion events, in stream order
+        ordered_parts = [
+            (peer_index, part_index, start, stop)
+            for peer_index, spans in enumerate(self.parts_by_peer)
+            for part_index, (start, stop) in enumerate(spans)
+        ]
+        ordered_parts.sort(key=lambda item: item[2])
+        cursor = 0  # next ordered part not yet awaited
+        offset = 0
+        for tensor in self.tensors:
+            numel = int(np.prod(tensor.shape))
+            tensor_end = offset + numel
+            while cursor < len(ordered_parts) and ordered_parts[cursor][2] < tensor_end:
+                peer_index, part_index, _start, _stop = ordered_parts[cursor]
+                await self._wait_part(peer_index, part_index)
+                cursor += 1
+            yield self._delta[offset:tensor_end].reshape(tensor.shape)
+            offset = tensor_end
+        self._finished.set()
+
+    def __repr__(self):
+        return (
+            f"TensorPartContainer({len(self.tensors)} tensors, {self.total_elements} elements, "
+            f"parts_by_peer={self.num_parts_by_peer})"
+        )
+
+
+class TensorPartReducer:
+    """Accumulates incoming parts for the span THIS peer reduces
+    (reference partition.py:179-286)."""
+
+    def __init__(self, part_shapes: Sequence[Tuple[int, ...]], num_senders: int):
+        self.part_shapes = list(part_shapes)
+        self.num_senders = num_senders
+        self.sender_failed = [False] * num_senders
+        # per-part: accumulator, total weight, contributed sender flags, done future
+        self._parts: Dict[int, dict] = {}
+        self._closed = False
+
+    def _part_state(self, part_index: int) -> dict:
+        if part_index not in self._parts:
+            if not (0 <= part_index < len(self.part_shapes)):
+                raise AllreduceException(f"invalid part index {part_index}")
+            self._parts[part_index] = dict(
+                accumulator=np.zeros(self.part_shapes[part_index], np.float32),
+                total_weight=0.0,
+                contributed=[False] * self.num_senders,
+                future=asyncio.get_event_loop().create_future(),
+            )
+        return self._parts[part_index]
+
+    @property
+    def num_active_senders(self) -> int:
+        return sum(not failed for failed in self.sender_failed)
+
+    async def accumulate_part(
+        self, sender_index: int, part_index: int, part: np.ndarray, weight: float = 1.0
+    ) -> np.ndarray:
+        """Add one sender's part; resolves to the weighted average once every active
+        sender has contributed."""
+        if self._closed:
+            raise AllreduceException("reducer is closed")
+        state = self._part_state(part_index)
+        if state["contributed"][sender_index]:
+            raise AllreduceException(f"sender {sender_index} sent part {part_index} twice")
+        part32 = part.reshape(state["accumulator"].shape).astype(np.float32)
+        state["accumulator"] += part32 * weight
+        state["total_weight"] += weight
+        state["contributed"][sender_index] = True
+        self._maybe_finish(part_index)
+        return await asyncio.shield(state["future"])
+
+    def on_sender_failed(self, sender_index: int) -> None:
+        """Shrink denominators for parts the dead sender had not contributed to
+        (reference partition.py:248-255)."""
+        if self.sender_failed[sender_index]:
+            return
+        self.sender_failed[sender_index] = True
+        for part_index in range(len(self.part_shapes)):
+            # started parts re-check completion; if ALL senders are gone, untouched
+            # parts must fail immediately instead of hanging their awaiters
+            if part_index in self._parts or self.num_active_senders == 0:
+                self._maybe_finish(part_index)
+
+    def _maybe_finish(self, part_index: int) -> None:
+        if part_index not in self._parts and self.num_active_senders == 0:
+            # everyone died before sending this part
+            state = self._part_state(part_index)
+            if not state["future"].done():
+                state["future"].set_exception(AllreduceException("all senders failed"))
+            return
+        if part_index not in self._parts:
+            return
+        state = self._parts[part_index]
+        if state["future"].done():
+            return
+        pending = [
+            i for i in range(self.num_senders) if not state["contributed"][i] and not self.sender_failed[i]
+        ]
+        if pending:
+            return
+        if state["total_weight"] <= 0:
+            state["future"].set_exception(AllreduceException(f"part {part_index}: no live contributions"))
+            return
+        state["future"].set_result(state["accumulator"] / state["total_weight"])
+
+    def finalize(self) -> None:
+        self._closed = True
+        for state in self._parts.values():
+            if not state["future"].done():
+                state["future"].set_exception(AllreduceException("reducer finalized early"))

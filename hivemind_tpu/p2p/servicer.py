@@ -85,9 +85,12 @@ class ServicerBase:
 
     @classmethod
     def _handle_name(cls, method_name: str, namespace: Optional[str]) -> str:
+        # subclasses may pin a shared wire name (e.g. every averager subclass speaks
+        # as "DecentralizedAverager") so heterogeneous peers interoperate
+        class_name = getattr(cls, "_class_handle_name", cls.__name__)
         if namespace is not None:
-            return f"{namespace}::{cls.__name__}.{method_name}"
-        return f"{cls.__name__}.{method_name}"
+            return f"{namespace}::{class_name}.{method_name}"
+        return f"{class_name}.{method_name}"
 
     async def add_p2p_handlers(
         self, p2p: P2P, wrapper: Optional[object] = None, *, namespace: Optional[str] = None

@@ -1,0 +1,166 @@
+"""Fault-injection matrix: a faulty peer dies/stalls at each stage of the all-reduce;
+surviving peers must still complete with consistent averages
+(scope: reference tests/test_allreduce_fault_tolerance.py:22-120)."""
+
+import asyncio
+from enum import Enum, auto
+
+import numpy as np
+import pytest
+
+from hivemind_tpu.averaging import AllReduceRunner, DecentralizedAverager
+from hivemind_tpu.averaging.allreduce import AveragingMode
+from hivemind_tpu.dht import DHT
+from hivemind_tpu.proto import averaging_pb2
+
+
+class Fault(Enum):
+    NONE = auto()
+    FAIL_BEFORE = auto()  # dies after matchmaking, before sending anything
+    FAIL_SENDING = auto()  # sends the first part, then closes its streams
+    SLOW_SENDING = auto()  # stalls while sending
+    FAIL_REDUCING = auto()  # returns one delta, then stops reducing
+    SLOW_REDUCING = auto()  # stalls while reducing
+
+
+class FaultyAllReduceRunner(AllReduceRunner):
+    def __init__(self, *args, fault: Fault, **kwargs):
+        self.fault = fault
+        super().__init__(*args, **kwargs)
+
+    async def _communicate_with_peer(self, peer_index):
+        if self.fault in (Fault.FAIL_SENDING, Fault.SLOW_SENDING):
+            peer_id = self.ordered_peer_ids[peer_index]
+            stub = self.get_stub(peer_id)
+
+            async def _requests():
+                first = True
+                async for serialized in self.container.iterate_input_parts_for(peer_index):
+                    if not first:
+                        if self.fault == Fault.SLOW_SENDING:
+                            await asyncio.sleep(30)
+                        return  # FAIL_SENDING: close stream after one part
+                    yield averaging_pb2.AveragingData(
+                        code=averaging_pb2.PART_DATA,
+                        group_id=self.group_id,
+                        tensor_part=serialized,
+                        weight=self.weight,
+                    )
+                    first = False
+
+            try:
+                async for _response in stub.rpc_aggregate_part(_requests()):
+                    pass
+            except Exception:
+                pass
+            self.container.register_failed_reducer(peer_index)
+            return
+        await super()._communicate_with_peer(peer_index)
+
+    async def handle_aggregate_stream(self, first_message, requests, context):
+        if self.fault in (Fault.FAIL_REDUCING, Fault.SLOW_REDUCING):
+            count = 0
+            async for message in super().handle_aggregate_stream(first_message, requests, context):
+                yield message
+                count += 1
+                if count >= 1:
+                    if self.fault == Fault.SLOW_REDUCING:
+                        await asyncio.sleep(30)
+                    return  # close the response stream early
+            return
+        async for message in super().handle_aggregate_stream(first_message, requests, context):
+            yield message
+
+
+class FaultyAverager(DecentralizedAverager):
+    def __init__(self, *args, fault: Fault = Fault.NONE, **kwargs):
+        self.fault = fault
+        super().__init__(*args, **kwargs)
+
+    def _make_allreduce_runner(self, group_info, peer_element_counts, modes, weight):
+        if self.fault == Fault.FAIL_BEFORE:
+            raise RuntimeError("injected failure before allreduce")
+        if self.fault == Fault.NONE:
+            return super()._make_allreduce_runner(group_info, peer_element_counts, modes, weight)
+        return FaultyAllReduceRunner(
+            fault=self.fault,
+            p2p=self.p2p,
+            group_id=group_info.group_id,
+            tensors=self._snapshot_tensors(),
+            ordered_peer_ids=group_info.peer_ids,
+            peer_element_counts=peer_element_counts,
+            modes=modes,
+            get_stub=self._get_peer_stub,
+            weight=weight,
+            compression=self.compression,
+            part_size_bytes=self.part_size_bytes,
+            sender_timeout=self.sender_timeout,
+            reducer_timeout=self.reducer_timeout,
+        )
+
+
+def launch_faulty_swarm(n_peers: int, fault_index: int, fault: Fault, part_size_bytes=64):
+    first = DHT(start=True)
+    maddrs = [str(m) for m in first.get_visible_maddrs()]
+    dhts = [first] + [DHT(initial_peers=maddrs, start=True) for _ in range(n_peers - 1)]
+    averagers = []
+    for i, dht in enumerate(dhts):
+        rng = np.random.RandomState(100 + i)
+        tensors = [rng.randn(256).astype(np.float32)]
+        averagers.append(
+            FaultyAverager(
+                tensors, dht, prefix="faulttest", start=True,
+                target_group_size=n_peers,
+                min_matchmaking_time=1.0, request_timeout=1.0,
+                sender_timeout=2.0, reducer_timeout=4.0,
+                part_size_bytes=part_size_bytes,  # small parts: faults hit mid-stream
+                fault=fault if i == fault_index else Fault.NONE,
+            )
+        )
+    return dhts, averagers
+
+
+@pytest.mark.parametrize(
+    "fault",
+    [Fault.NONE, Fault.FAIL_BEFORE, Fault.FAIL_SENDING, Fault.SLOW_SENDING, Fault.FAIL_REDUCING, Fault.SLOW_REDUCING],
+    ids=lambda f: f.name,
+)
+def test_allreduce_fault_tolerance(fault):
+    n_peers, fault_index = 4, 1
+    dhts, averagers = launch_faulty_swarm(n_peers, fault_index, fault)
+    try:
+        controls = [a.step(wait=False, timeout=25, allow_retries=False) for a in averagers]
+        survivor_results = {}
+        for i, control in enumerate(controls):
+            try:
+                result = control.result(timeout=40)
+                survivor_results[i] = result
+            except Exception:
+                assert i == fault_index or fault in (Fault.SLOW_SENDING, Fault.SLOW_REDUCING), (
+                    f"healthy peer {i} failed under fault {fault.name}"
+                )
+        survivors = [i for i in survivor_results if i != fault_index]
+        assert len(survivors) >= n_peers - 2, f"too many casualties under {fault.name}: {survivors}"
+
+        values = {}
+        for i in survivors:
+            with averagers[i].get_tensors() as tensors:
+                values[i] = tensors[0].copy()
+        if fault == Fault.NONE:
+            # everyone (incl. peer 1) must hold the exact same average
+            reference_value = values[survivors[0]]
+            for i in survivors[1:]:
+                assert np.allclose(values[i], reference_value, atol=1e-4)
+        else:
+            # spans reduced by surviving reducers must agree across all survivors;
+            # at least half of the vector must have been successfully averaged
+            agreement = np.mean(
+                [np.isclose(values[survivors[0]], values[i], atol=1e-4) for i in survivors[1:]],
+                axis=0,
+            )
+            assert agreement.mean() >= 0.5, f"{fault.name}: survivors agree on only {agreement.mean():.0%}"
+    finally:
+        for averager in averagers:
+            averager.shutdown()
+        for dht in dhts:
+            dht.shutdown()
