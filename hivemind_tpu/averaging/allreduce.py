@@ -254,9 +254,15 @@ class AllReduceRunner:
                         timeout=self.reducer_timeout,
                     )
                 except asyncio.TimeoutError:
+                    # failing the laggards may resolve the part right now — the
+                    # on-time sender whose wait expired must still get its delta
                     self._fail_laggards(part_index)
-                    yield averaging_pb2.AveragingData(code=averaging_pb2.CANCELLED)
-                    return
+                    state = self.reducer._parts.get(part_index)
+                    if state is not None and state["future"].done() and state["future"].exception() is None:
+                        averaged = state["future"].result()
+                    else:
+                        yield averaging_pb2.AveragingData(code=averaging_pb2.CANCELLED)
+                        return
                 delta = averaged - part.astype(np.float32)
                 yield averaging_pb2.AveragingData(
                     code=averaging_pb2.PART_DATA,

@@ -304,8 +304,11 @@ class DecentralizedAverager(ServicerBase):
                         control.set_exception(e)
                         return
                     logger.debug(f"averaging attempt failed: {e!r}; retrying")
-                    # rescheduled attempt: aim a fresh matchmaking window
-                    control.reset_for_retry(get_dht_time() + self.min_matchmaking_time)
+                    # fresh matchmaking window with jitter: symmetric failures would
+                    # otherwise re-synchronize and livelock (everyone re-declares the
+                    # same deadline and nobody becomes anyone's leader)
+                    jitter = random.uniform(0.8, 1.6)
+                    control.reset_for_retry(get_dht_time() + self.min_matchmaking_time * jitter)
         except asyncio.CancelledError:
             control.cancel()
             raise

@@ -70,6 +70,7 @@ class Matchmaking:
         self.data_for_gather: bytes = b""
         self.assembled_group: Optional[GroupInfo] = None
         self._tried_leaders: set = set()
+        self._join_in_progress = False  # excludes full-group assembly while we court a leader
 
     @property
     def is_looking_for_group(self) -> bool:
@@ -96,9 +97,17 @@ class Matchmaking:
             )
             if timeout is not None:
                 self.declared_expiration_time = min(self.declared_expiration_time, now + timeout)
+            declared_key = self.key_manager.current_key  # rebucketing may change it mid-round
             declare_task = None
             if not self.client_mode:
-                declare_task = asyncio.create_task(self._declare_periodically())
+                # land our own declaration BEFORE searching: peers must be able to
+                # find us for the whole window, or near-simultaneous searchers can
+                # repeatedly miss each other
+                with contextlib.suppress(Exception):
+                    await self.key_manager.declare_averager(
+                        declared_key, self.peer_id, self.declared_expiration_time
+                    )
+                declare_task = asyncio.create_task(self._declare_periodically(declared_key))
             try:
                 return await self._search_until_deadline()
             finally:
@@ -107,21 +116,22 @@ class Matchmaking:
                 if declare_task is not None:
                     await cancel_and_wait(declare_task)
                     with contextlib.suppress(Exception):
+                        # retract under the key we DECLARED under, not the new bucket
                         await self.key_manager.declare_averager(
-                            self.key_manager.current_key, self.peer_id, get_dht_time(), looking_for_group=False
+                            declared_key, self.peer_id, get_dht_time(), looking_for_group=False
                         )
                 if self.current_followers and self.assembled_group is None:
                     self._disband_followers(suggested_leader=None)
 
-    async def _declare_periodically(self) -> None:
-        key = self.key_manager.current_key
+    async def _declare_periodically(self, key: str) -> None:
+        # sleep FIRST: look_for_group already stored the initial declaration
         while True:
-            with contextlib.suppress(Exception):
-                await self.key_manager.declare_averager(key, self.peer_id, self.declared_expiration_time)
             remaining = self.declared_expiration_time - get_dht_time()
             if remaining <= 0:
                 return
             await asyncio.sleep(max(remaining / 2, 0.5))
+            with contextlib.suppress(Exception):
+                await self.key_manager.declare_averager(key, self.peer_id, self.declared_expiration_time)
 
     async def _search_until_deadline(self) -> Optional[GroupInfo]:
         while get_dht_time() < self.declared_expiration_time:
@@ -187,6 +197,7 @@ class Matchmaking:
 
     async def _request_join_one(self, leader: PeerID):
         stream = None
+        self._join_in_progress = True
         try:
             stub = self.get_stub(leader)
             request = averaging_pb2.JoinRequest(
@@ -229,6 +240,7 @@ class Matchmaking:
                 return None, PeerID(second.suggested_leader) if second.suggested_leader else None
             return None, None
         finally:
+            self._join_in_progress = False
             self.current_leader = None
             if stream is not None:
                 with contextlib.suppress(Exception):
@@ -253,6 +265,7 @@ class Matchmaking:
                 self.target_group_size is not None
                 and len(self.current_followers) + 1 >= self.target_group_size
                 and self.current_leader is None
+                and not self._join_in_progress  # split-brain guard: we may be mid-join
                 and self.assembled_group is None
             ):
                 self._leader_assemble_group()  # group is full: begin early

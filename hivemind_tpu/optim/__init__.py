@@ -1,0 +1,8 @@
+from hivemind_tpu.optim.grad_averager import GradientAverager
+from hivemind_tpu.optim.optimizer import Optimizer
+from hivemind_tpu.optim.progress_tracker import (
+    GlobalTrainingProgress,
+    LocalTrainingProgress,
+    ProgressTracker,
+)
+from hivemind_tpu.optim.state_averager import TrainingStateAverager

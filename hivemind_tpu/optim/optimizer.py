@@ -1,0 +1,315 @@
+"""hivemind_tpu.Optimizer — train collaboratively with an elastic swarm of unreliable
+peers (capability parity: reference hivemind/optim/optimizer.py:32-790).
+
+jax-first API: instead of wrapping a torch optimizer (loss.backward(); opt.step()),
+the user's jitted step computes gradients and passes them in; ``step`` returns the
+current parameter pytree:
+
+    opt = Optimizer(dht=dht, run_id="run", params=params, optimizer=optax.adam(1e-3),
+                    target_batch_size=4096, batch_size_per_step=32)
+    loss, grads = jitted_loss_and_grad(opt.params, batch)
+    params = opt.step(grads)
+
+Semantics match the reference: progress is measured in virtual "epochs" of
+``target_batch_size`` samples accumulated ACROSS the swarm; when the swarm reaches the
+target, peers average their accumulated gradients (weighted by contribution), apply
+one optax update each, and advance the epoch — equivalent to large-batch synchronous
+training, invariant to swarm size (reference optimizer.py:63-69)."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Iterable, Optional
+
+import numpy as np
+
+from hivemind_tpu.averaging.control import AveragingStage, StepControl
+from hivemind_tpu.compression import CompressionBase, Float16Compression, NoCompression
+from hivemind_tpu.dht import DHT
+from hivemind_tpu.optim.grad_averager import GradientAverager
+from hivemind_tpu.optim.progress_tracker import ProgressTracker
+from hivemind_tpu.optim.state_averager import TrainingStateAverager
+from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.timed_storage import get_dht_time
+
+logger = get_logger(__name__)
+
+
+class Optimizer:
+    """See module docstring.
+
+    :param run_id: unique swarm identifier; peers with the same run_id train together
+    :param target_batch_size: global samples per virtual epoch
+    :param batch_size_per_step: default samples per local step (overridable per call)
+    :param use_local_updates: apply optax updates locally every step and average
+        PARAMETERS periodically instead of gradients (asynchronous mode)
+    :param average_state_every: average parameters/opt stats every N epochs
+    :param auxiliary: no data/gradients of its own; assists group averaging only
+    :param delay_optimizer_step / delay_grad_averaging: reserved (reference DPU
+        options); currently averaging overlap comes from pre-scheduled matchmaking
+    """
+
+    def __init__(
+        self,
+        *,
+        dht: DHT,
+        run_id: str,
+        target_batch_size: int,
+        params: Any = None,
+        optimizer: Any = None,
+        batch_size_per_step: Optional[int] = None,
+        matchmaking_time: float = 5.0,
+        averaging_timeout: float = 60.0,
+        load_state_timeout: float = 60.0,
+        average_state_every: int = 1,
+        use_local_updates: bool = False,
+        client_mode: bool = False,
+        auxiliary: bool = False,
+        grad_compression: CompressionBase = Float16Compression(),
+        state_averaging_compression: CompressionBase = Float16Compression(),
+        target_group_size: Optional[int] = None,
+        min_group_size: int = 2,
+        grad_averager_opts: Optional[dict] = None,
+        state_averager_opts: Optional[dict] = None,
+        tracker_opts: Optional[dict] = None,
+        shutdown_timeout: float = 5.0,
+        verbose: bool = False,
+    ):
+        assert not (client_mode and auxiliary), "a peer is either a client or an auxiliary, not both"
+        assert auxiliary or (params is not None and optimizer is not None), (
+            "non-auxiliary peers must provide params and an optax optimizer"
+        )
+        self.dht, self.run_id = dht, run_id
+        self.target_batch_size = target_batch_size
+        self.batch_size_per_step = batch_size_per_step
+        self.matchmaking_time, self.averaging_timeout = matchmaking_time, averaging_timeout
+        self.load_state_timeout = load_state_timeout
+        self.average_state_every = average_state_every
+        self.use_local_updates = use_local_updates
+        self.client_mode, self.auxiliary = client_mode, auxiliary
+        self.shutdown_timeout = shutdown_timeout
+        self.verbose = verbose
+        self.scheduled_grads: Optional[StepControl] = None
+        self._step_lock = threading.Lock()
+
+        averager_common = dict(
+            target_group_size=target_group_size,
+            min_group_size=min_group_size,
+            min_matchmaking_time=matchmaking_time,
+            client_mode=client_mode,
+            auxiliary=auxiliary,
+        )
+        self.state_averager: Optional[TrainingStateAverager] = None
+        if not auxiliary:
+            self.state_averager = TrainingStateAverager(
+                dht=dht,
+                optimizer=optimizer,
+                params=params,
+                prefix=f"{run_id}_state",
+                start=True,
+                compression=state_averaging_compression,
+                state_compression=state_averaging_compression,
+                **averager_common,
+                **(state_averager_opts or {}),
+            )
+        self.grad_averager: Optional[GradientAverager] = None
+        if not use_local_updates:
+            tensors_like = (
+                self.state_averager._host_state_tensors()[: len(self.state_averager._params_flat)]
+                if self.state_averager is not None
+                else []
+            )
+            if auxiliary:
+                # aux peers need the schema to join groups; fetch it lazily from peers
+                # is future work — for now aux requires params_like via grad_averager_opts
+                tensors_like = (grad_averager_opts or {}).pop("tensors_like", [])
+            self.grad_averager = GradientAverager(
+                tensors_like,
+                dht=dht,
+                prefix=f"{run_id}_grad_averager",
+                start=True,
+                compression=grad_compression,
+                **averager_common,
+                **(grad_averager_opts or {}),
+            )
+        self.tracker = ProgressTracker(
+            dht, run_id, target_batch_size, client_mode=client_mode or auxiliary,
+            **(tracker_opts or {}),
+        )
+
+    # ------------------------------------------------------------------ properties
+
+    @property
+    def params(self) -> Any:
+        assert self.state_averager is not None
+        return self.state_averager.params
+
+    @property
+    def local_epoch(self) -> int:
+        return self.state_averager.local_epoch if self.state_averager is not None else self.tracker.global_epoch
+
+    @property
+    def ready_to_update_epoch(self) -> bool:
+        return self.tracker.ready_to_update_epoch
+
+    # ------------------------------------------------------------------ main entry
+
+    def step(
+        self,
+        grads: Any = None,
+        batch_size: Optional[int] = None,
+    ) -> Any:
+        """Report progress, accumulate gradients, and run the collaborative update
+        when the swarm is ready. Returns the (possibly updated) parameter pytree."""
+        if self.auxiliary:
+            self._auxiliary_step()
+            return None
+        assert self.state_averager is not None
+        with self._step_lock:
+            if self.local_epoch < self.tracker.global_epoch:
+                self._catch_up_with_swarm()
+
+            batch_size = batch_size if batch_size is not None else (self.batch_size_per_step or 1)
+            if self.use_local_updates:
+                return self._local_updates_step(grads, batch_size)
+            return self._collaborative_step(grads, batch_size)
+
+    def _collaborative_step(self, grads: Any, batch_size: int) -> Any:
+        assert self.grad_averager is not None and self.state_averager is not None
+        if grads is not None:
+            import jax
+
+            grads_flat = jax.tree_util.tree_flatten(grads)[0] if not isinstance(grads, (list, tuple)) else list(grads)
+            self.grad_averager.accumulate_grads_(grads_flat, batch_size)
+        self.tracker.report_local_progress(self.local_epoch, self.grad_averager.local_samples_accumulated)
+        self._maybe_schedule_gradient_averaging()
+        if self.tracker.ready_to_update_epoch:
+            self._update_global_epoch()
+        return self.state_averager.params
+
+    def _local_updates_step(self, grads: Any, batch_size: int) -> Any:
+        """Asynchronous mode: apply updates locally, average parameters periodically
+        (reference use_local_updates, optimizer.py:143-145)."""
+        assert self.state_averager is not None
+        if grads is not None:
+            self.state_averager.apply_optimizer_step(grads)
+        new_samples = self.tracker.local_progress.samples_accumulated + batch_size
+        self.tracker.report_local_progress(self.local_epoch, new_samples)
+        if self.tracker.ready_to_update_epoch:
+            self.state_averager.local_epoch += 1
+            if self.local_epoch % self.average_state_every == 0:
+                self.state_averager.do_averaging_round(
+                    timeout=self.averaging_timeout,
+                    scheduled_time=get_dht_time() + self.matchmaking_time,
+                )
+            self.tracker.update_epoch(self.local_epoch)
+        return self.state_averager.params
+
+    def _auxiliary_step(self) -> None:
+        """Aux peers keep assisting gradient averaging rounds near epoch ends."""
+        assert self.grad_averager is not None
+        if self.tracker.ready_to_update_epoch:
+            with contextlib.suppress(Exception):
+                self.grad_averager.step(
+                    weight=0.0, timeout=self.averaging_timeout,
+                    scheduled_time=get_dht_time() + self.matchmaking_time,
+                )
+            self.tracker.update_epoch(self.tracker.global_epoch + 1)
+
+    # ------------------------------------------------------------------ internals
+
+    def _maybe_schedule_gradient_averaging(self) -> None:
+        """Pre-schedule matchmaking so the group is ready the moment the swarm hits
+        the target batch size (reference optimizer.py:559-567)."""
+        assert self.grad_averager is not None
+        eta = self.tracker.global_progress.eta_next_epoch - get_dht_time()
+        if eta <= self.matchmaking_time * 2 and self._scheduled_control_invalid():
+            scheduled_time = get_dht_time() + max(eta, 1e-2)
+            self.scheduled_grads = self.grad_averager.schedule_step(
+                scheduled_time=scheduled_time, timeout=self.averaging_timeout
+            )
+            logger.debug(f"pre-scheduled gradient averaging in {eta:.1f}s")
+
+    def _scheduled_control_invalid(self) -> bool:
+        control = self.scheduled_grads
+        return control is None or control.done() or control.cancelled
+
+    def _update_global_epoch(self) -> None:
+        """Average gradients with the swarm, apply one optax update, advance the epoch
+        (reference _update_global_epoch, optimizer.py:438-509)."""
+        assert self.grad_averager is not None and self.state_averager is not None
+        next_epoch = max(self.local_epoch, self.tracker.global_epoch) + 1
+
+        averaged_ok = False
+        if self.tracker.global_progress.num_peers > 1:
+            control = None if self._scheduled_control_invalid() else self.scheduled_grads
+            self.scheduled_grads = None
+            try:
+                # keep the accumulators until the update is applied: if averaging
+                # fails we must fall back to the LOCAL gradients, not zeros
+                self.grad_averager.step(
+                    control=control,
+                    weight=self.grad_averager.local_samples_accumulated,
+                    timeout=self.averaging_timeout,
+                    reset_accumulators=False,
+                    scheduled_time=get_dht_time() + self.matchmaking_time if control is None else None,
+                )
+                averaged_ok = True
+            except Exception as e:
+                logger.warning(f"gradient averaging failed ({e!r}); applying local gradients")
+        if not averaged_ok:
+            # fall back to local gradients (reference optimizer.py:632-639)
+            self.grad_averager.load_accumulators_into_averager_()
+
+        with self.grad_averager.use_averaged_gradients() as averaged_grads:
+            self.state_averager.apply_optimizer_step(list(averaged_grads))
+        self.grad_averager.reset_accumulated_grads_()
+
+        self.state_averager.local_epoch = next_epoch
+        if self.average_state_every and next_epoch % self.average_state_every == 0 and self.tracker.global_progress.num_peers > 1:
+            self.state_averager.do_averaging_round(
+                timeout=self.averaging_timeout,
+                scheduled_time=get_dht_time() + self.matchmaking_time,
+            )
+        self.state_averager.state_sharing_priority = next_epoch
+        self.tracker.update_epoch(next_epoch)
+        if self.verbose:
+            logger.info(
+                f"transitioned to epoch {next_epoch} "
+                f"(averaged={averaged_ok}, peers={self.tracker.global_progress.num_peers})"
+            )
+
+    def _catch_up_with_swarm(self) -> None:
+        """We are behind the swarm: adopt a peer's state
+        (reference _should_load_state_from_peers + load_state_from_peers)."""
+        assert self.state_averager is not None
+        logger.info(
+            f"local epoch {self.local_epoch} is behind the swarm ({self.tracker.global_epoch}); "
+            f"downloading state"
+        )
+        if self.state_averager.load_full_state_from_peers(timeout=self.load_state_timeout):
+            if self.grad_averager is not None:
+                self.grad_averager.reset_accumulated_grads_()
+        else:
+            # could not download: adopt the epoch number to avoid re-triggering forever
+            self.state_averager.local_epoch = self.tracker.global_epoch
+
+    def load_state_from_peers(self, timeout: Optional[float] = None) -> bool:
+        assert self.state_averager is not None
+        return self.state_averager.load_full_state_from_peers(timeout=timeout or self.load_state_timeout)
+
+    def shutdown(self) -> None:
+        self.tracker.shutdown()
+        if self.scheduled_grads is not None:
+            self.scheduled_grads.cancel()
+        if self.grad_averager is not None:
+            self.grad_averager.shutdown()
+        if self.state_averager is not None:
+            self.state_averager.shutdown()
+
+    def __repr__(self):
+        return (
+            f"Optimizer(run_id={self.run_id!r}, epoch={self.local_epoch}, "
+            f"local_updates={self.use_local_updates}, client={self.client_mode}, aux={self.auxiliary})"
+        )

@@ -1,0 +1,289 @@
+"""Swarm-wide training progress accounting (capability parity: reference
+hivemind/optim/progress_tracker.py).
+
+Every peer publishes LocalTrainingProgress (signed with its key) as a subkey of
+``{run_id}_progress``; the tracker aggregates all records into GlobalTrainingProgress
+and estimates when the swarm will finish the current virtual epoch. Epoch-based
+accounting makes hyperparameters invariant to swarm size (reference optimizer.py:63-69)."""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import threading
+from typing import Dict, Optional
+
+import pydantic
+
+from hivemind_tpu.dht import DHT
+from hivemind_tpu.dht.crypto import Ed25519SignatureValidator
+from hivemind_tpu.dht.schema import BytesWithEd25519PublicKey, SchemaValidator
+from hivemind_tpu.utils.crypto import Ed25519PrivateKey
+from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.loop import LoopRunner, get_loop_runner
+from hivemind_tpu.utils.performance_ema import PerformanceEMA
+from hivemind_tpu.utils.timed_storage import DHTExpiration, get_dht_time
+
+logger = get_logger(__name__)
+
+
+class LocalTrainingProgress(pydantic.BaseModel):
+    peer_id: bytes
+    epoch: int
+    samples_accumulated: int
+    samples_per_second: float
+    time: float
+    client_mode: bool
+
+    @pydantic.field_validator("epoch", "samples_accumulated")
+    @classmethod
+    def _non_negative(cls, value):
+        assert value >= 0
+        return value
+
+    @pydantic.field_validator("samples_per_second")
+    @classmethod
+    def _finite_positive(cls, value):
+        assert value >= 0 and value == value  # not NaN
+        return value
+
+
+class GlobalTrainingProgress(pydantic.BaseModel):
+    global_epoch: int
+    samples_accumulated: int
+    target_batch_size: int
+    num_peers: int
+    num_clients: int
+    eta_next_epoch: float
+    next_fetch_time: float
+
+    @property
+    def ready_to_update_epoch(self) -> bool:
+        return (
+            self.samples_accumulated >= self.target_batch_size
+            or get_dht_time() >= self.eta_next_epoch
+        )
+
+
+class ProgressTracker:
+    """Publishes local progress and aggregates the swarm's; runs reporter + fetcher
+    tasks on the shared event loop (the reference uses a thread,
+    progress_tracker.py:44-363)."""
+
+    def __init__(
+        self,
+        dht: DHT,
+        prefix: str,
+        target_batch_size: int,
+        *,
+        client_mode: bool = False,
+        min_refresh_period: float = 0.5,
+        max_refresh_period: float = 10.0,
+        default_refresh_period: float = 3.0,
+        expected_drift_peers: float = 3.0,
+        expected_drift_rate: float = 0.2,
+        performance_ema_alpha: float = 0.1,
+        metadata_expiration: float = 60.0,
+        private_key: Optional[Ed25519PrivateKey] = None,
+        start: bool = True,
+        loop_runner: Optional[LoopRunner] = None,
+    ):
+        self.dht, self.prefix = dht, prefix
+        self.target_batch_size = target_batch_size
+        self.client_mode = client_mode
+        self.min_refresh_period, self.max_refresh_period = min_refresh_period, max_refresh_period
+        self.default_refresh_period = default_refresh_period
+        self.expected_drift_peers, self.expected_drift_rate = expected_drift_peers, expected_drift_rate
+        self.metadata_expiration = metadata_expiration
+        self.performance_ema = PerformanceEMA(alpha=performance_ema_alpha, paused=True)
+        self._runner = loop_runner if loop_runner is not None else get_loop_runner()
+
+        if private_key is None:
+            # sign with THIS peer's transport identity (not the process-wide singleton:
+            # several in-process peers would collide on one subkey)
+            private_key = dht.node.p2p.identity
+        signature_validator = Ed25519SignatureValidator(private_key)
+        progress_key_name = f"{prefix}_progress"
+        schema = pydantic.create_model(
+            "_TrackerSchema",
+            **{progress_key_name: (Dict[BytesWithEd25519PublicKey, LocalTrainingProgress], ...)},
+        )
+        self.dht.add_validators([SchemaValidator(schema, allow_extra_keys=True), signature_validator])
+        self._local_public_key = signature_validator.local_public_key
+        self.progress_key = progress_key_name
+
+        self.local_progress = LocalTrainingProgress(
+            peer_id=dht.peer_id.to_bytes(),
+            epoch=0,
+            samples_accumulated=0,
+            samples_per_second=0.0,
+            time=get_dht_time(),
+            client_mode=client_mode,
+        )
+        self.global_progress = GlobalTrainingProgress(
+            global_epoch=0,
+            samples_accumulated=0,
+            target_batch_size=target_batch_size,
+            num_peers=0,
+            num_clients=0,
+            eta_next_epoch=get_dht_time() + max_refresh_period,
+            next_fetch_time=get_dht_time(),
+        )
+        self._lock = threading.Lock()
+        self._report_event: Optional[asyncio.Event] = None
+        self._fetch_soon: Optional[asyncio.Event] = None
+        self._reporter_task = None
+        self._fetcher_task = None
+        self.shutdown_requested = False
+        if start:
+            self._runner.run_coroutine(self._start_tasks())
+
+    async def _start_tasks(self) -> None:
+        self._report_event = asyncio.Event()
+        self._fetch_soon = asyncio.Event()
+        self._reporter_task = asyncio.create_task(self._reporter())
+        self._fetcher_task = asyncio.create_task(self._fetcher())
+
+    # ------------------------------------------------------------------ local side
+
+    @property
+    def global_epoch(self) -> int:
+        return self.global_progress.global_epoch
+
+    @property
+    def ready_to_update_epoch(self) -> bool:
+        return self.global_progress.ready_to_update_epoch
+
+    def report_local_progress(self, local_epoch: int, samples_accumulated: int, update_ema: bool = True) -> None:
+        """Update the local record and wake the reporter
+        (reference progress_tracker.py:153-168)."""
+        with self._lock:
+            extra_samples = samples_accumulated - self.local_progress.samples_accumulated
+            if update_ema and extra_samples > 0:
+                if self.performance_ema.paused:
+                    self.performance_ema.paused = False
+                    self.performance_ema.reset_timer()
+                else:
+                    self.performance_ema.update(extra_samples)
+            self.local_progress = LocalTrainingProgress(
+                peer_id=self.dht.peer_id.to_bytes(),
+                epoch=local_epoch,
+                samples_accumulated=samples_accumulated,
+                samples_per_second=self.performance_ema.samples_per_second,
+                time=get_dht_time(),
+                client_mode=self.client_mode,
+            )
+        self._wake_reporter()
+
+    def update_epoch(self, new_epoch: int) -> None:
+        with self._lock:
+            self.local_progress = self.local_progress.model_copy(
+                update=dict(epoch=new_epoch, samples_accumulated=0, time=get_dht_time())
+            )
+            if new_epoch > self.global_progress.global_epoch:
+                self.global_progress.global_epoch = new_epoch
+                self.global_progress.samples_accumulated = 0
+            self.global_progress.next_fetch_time = get_dht_time()
+        self.performance_ema.paused = True
+        self._wake_reporter()
+        self._wake_fetcher()
+
+    def _wake_reporter(self) -> None:
+        if self._report_event is not None:
+            self._runner.call_soon(self._report_event.set)
+
+    def _wake_fetcher(self) -> None:
+        if self._fetch_soon is not None:
+            self._runner.call_soon(self._fetch_soon.set)
+
+    # ------------------------------------------------------------------ tasks
+
+    async def _reporter(self) -> None:
+        """Store the local progress record whenever it changes (plus heartbeats)."""
+        assert self._report_event is not None
+        while not self.shutdown_requested:
+            # clear BEFORE snapshotting: an update arriving mid-store must survive
+            # into the next iteration, not be silently dropped
+            self._report_event.clear()
+            with contextlib.suppress(Exception):
+                with self._lock:
+                    record = self.local_progress
+                await self.dht.node.store(
+                    self.progress_key,
+                    subkey=self._local_public_key,
+                    value=record.model_dump(),
+                    expiration_time=get_dht_time() + self.metadata_expiration,
+                )
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(self._report_event.wait(), timeout=self.metadata_expiration / 2)
+
+    async def _fetcher(self) -> None:
+        """Aggregate everyone's records into GlobalTrainingProgress
+        (reference progress_tracker.py:231-273)."""
+        while not self.shutdown_requested:
+            assert self._fetch_soon is not None
+            wait_time = max(0.0, self.global_progress.next_fetch_time - get_dht_time())
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(self._fetch_soon.wait(), timeout=wait_time)
+            self._fetch_soon.clear()
+            with contextlib.suppress(Exception):
+                await self._fetch_global_progress()
+
+    async def _fetch_global_progress(self) -> None:
+        response = await self.dht.node.get(self.progress_key, latest=True)
+        records = []
+        current_time = get_dht_time()
+        if response is not None and isinstance(response.value, dict):
+            for _subkey, entry in response.value.items():
+                try:
+                    record = LocalTrainingProgress.model_validate(entry.value)
+                    if current_time - record.time <= self.metadata_expiration:
+                        records.append(record)
+                except Exception:
+                    continue
+        with self._lock:
+            local = self.local_progress
+        if not any(r.peer_id == local.peer_id for r in records):
+            records.append(local)
+
+        global_epoch = max((r.epoch for r in records), default=local.epoch)
+        samples = sum(r.samples_accumulated for r in records if r.epoch == global_epoch)
+        samples_per_second = sum(r.samples_per_second for r in records if r.epoch == global_epoch) or 1e-9
+        num_peers = len(records)
+        num_clients = sum(r.client_mode for r in records)
+        remaining = max(0, self.target_batch_size - samples)
+        eta_seconds = remaining / samples_per_second
+        # adaptive refresh: fetch more often as the epoch end approaches, accounting
+        # for expected peer churn (reference progress_tracker.py:321-331)
+        drift = self.expected_drift_peers + self.expected_drift_rate * num_peers
+        refresh = max(
+            self.min_refresh_period,
+            min(self.max_refresh_period, eta_seconds / max(drift, 1.0)),
+        )
+        with self._lock:
+            self.global_progress = GlobalTrainingProgress(
+                global_epoch=global_epoch,
+                samples_accumulated=samples,
+                target_batch_size=self.target_batch_size,
+                num_peers=num_peers,
+                num_clients=num_clients,
+                eta_next_epoch=get_dht_time() + eta_seconds,
+                next_fetch_time=get_dht_time() + refresh,
+            )
+
+    async def fetch_global_progress_now(self) -> GlobalTrainingProgress:
+        await self._fetch_global_progress()
+        return self.global_progress
+
+    def shutdown(self, timeout: float = 2.0) -> None:
+        self.shutdown_requested = True
+        self._wake_reporter()
+        self._wake_fetcher()
+
+        async def _cancel():
+            for task in (self._reporter_task, self._fetcher_task):
+                if task is not None:
+                    task.cancel()
+
+        with contextlib.suppress(Exception):
+            self._runner.run_coroutine(_cancel(), return_future=True).result(timeout)

@@ -1,0 +1,190 @@
+"""TrainingStateAverager: owns the optax optimizer + parameters and periodically
+averages them with peers (capability parity: reference hivemind/optim/state_averager.py).
+
+jax-first: the canonical train state (params + optax state) lives as device arrays;
+the optimizer update is a jitted pure function. The reference's CPU-offload machinery
+(offload_optimizer / reuse_tensors, state_averager.py:37-120) has no analog here —
+host staging IS the transport path: averaging rounds device_get the state, all-reduce
+it over the network, and device_put it back. Epoch-keyed schedules come for free:
+optax schedules see the update count, and one optimizer step == one epoch."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from hivemind_tpu.averaging.averager import DecentralizedAverager
+from hivemind_tpu.compression.base import as_numpy
+from hivemind_tpu.dht import DHT
+from hivemind_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class TrainingStateAverager(DecentralizedAverager):
+    """Averages model parameters (and optionally optimizer statistics) across peers.
+
+    :param optimizer: an optax.GradientTransformation
+    :param params: the initial parameter pytree (jax arrays or numpy)
+    :param average_opt_statistics: also average float optimizer-state leaves (e.g.
+        Adam's mu/nu) so joining peers inherit momentum
+    :param extra_tensors: additional arrays averaged and shared with state downloads
+    """
+
+    def __init__(
+        self,
+        *,
+        dht: DHT,
+        optimizer,
+        params: Any,
+        prefix: str,
+        average_opt_statistics: bool = True,
+        extra_tensors: Sequence = (),
+        **kwargs,
+    ):
+        import jax
+
+        self.optax_optimizer = optimizer
+        params_flat, self._params_treedef = jax.tree_util.tree_flatten(params)
+        self._params_flat = [jax.numpy.asarray(p) for p in params_flat]
+        self.opt_state = optimizer.init(jax.tree_util.tree_unflatten(self._params_treedef, self._params_flat))
+        self.average_opt_statistics = average_opt_statistics
+        self.extra_tensors = [np.array(as_numpy(t), copy=True) for t in extra_tensors]
+        self.local_epoch = 0
+        self._state_lock = threading.Lock()
+
+        opt_leaves, self._opt_treedef = jax.tree_util.tree_flatten(self.opt_state)
+        self._averaged_opt_indices = [
+            i
+            for i, leaf in enumerate(opt_leaves)
+            if average_opt_statistics
+            and hasattr(leaf, "dtype")
+            and np.issubdtype(np.asarray(leaf).dtype, np.floating)
+            and np.asarray(leaf).ndim >= 1
+        ]
+
+        @jax.jit
+        def _apply(params_flat, opt_state, grads_flat):
+            params_tree = jax.tree_util.tree_unflatten(self._params_treedef, params_flat)
+            grads_tree = jax.tree_util.tree_unflatten(self._params_treedef, grads_flat)
+            updates, new_opt_state = optimizer.update(grads_tree, opt_state, params_tree)
+            import optax
+
+            new_params = optax.apply_updates(params_tree, updates)
+            return jax.tree_util.tree_flatten(new_params)[0], new_opt_state
+
+        self._jitted_apply = _apply
+
+        averaged = self._host_state_tensors()
+        super().__init__(averaged_tensors=averaged, dht=dht, prefix=prefix, **kwargs)
+
+    # ------------------------------------------------------------------ state access
+
+    @property
+    def params(self) -> Any:
+        import jax
+
+        return jax.tree_util.tree_unflatten(self._params_treedef, self._params_flat)
+
+    @property
+    def params_flat(self) -> List:
+        return list(self._params_flat)
+
+    def _opt_leaves(self) -> list:
+        import jax
+
+        return jax.tree_util.tree_flatten(self.opt_state)[0]
+
+    def _host_state_tensors(self) -> List[np.ndarray]:
+        """The averageable view: params + chosen optimizer statistics + extras."""
+        tensors = [np.asarray(as_numpy(p), dtype=np.float32) for p in self._params_flat]
+        opt_leaves = self._opt_leaves()
+        tensors += [np.asarray(as_numpy(opt_leaves[i]), dtype=np.float32) for i in self._averaged_opt_indices]
+        tensors += [np.asarray(t, dtype=np.float32) for t in self.extra_tensors]
+        return tensors
+
+    def _load_host_state_tensors(self, tensors: List[np.ndarray]) -> None:
+        """Inverse of _host_state_tensors: write averaged values back to the device
+        state, preserving original dtypes."""
+        import jax
+        import jax.numpy as jnp
+
+        n_params = len(self._params_flat)
+        n_opt = len(self._averaged_opt_indices)
+        assert len(tensors) >= n_params + n_opt, "state tensor count mismatch"
+        with self._state_lock:
+            self._params_flat = [
+                jnp.asarray(tensor, dtype=p.dtype)
+                for tensor, p in zip(tensors[:n_params], self._params_flat)
+            ]
+            opt_leaves = self._opt_leaves()
+            for slot, tensor in zip(self._averaged_opt_indices, tensors[n_params : n_params + n_opt]):
+                opt_leaves[slot] = jnp.asarray(tensor, dtype=np.asarray(opt_leaves[slot]).dtype)
+            self.opt_state = jax.tree_util.tree_unflatten(self._opt_treedef, opt_leaves)
+            for extra, tensor in zip(self.extra_tensors, tensors[n_params + n_opt :]):
+                np.copyto(extra, tensor.reshape(extra.shape))
+
+    # ------------------------------------------------------------------ optimization
+
+    def apply_optimizer_step(self, grads: Any) -> None:
+        """One jitted optax update. ``grads`` may be a pytree matching params, or a
+        flat list of arrays (e.g. the averaged-gradient buffers)."""
+        import jax
+
+        if isinstance(grads, (list, tuple)) and len(grads) == len(self._params_flat):
+            grads_flat = [
+                jax.numpy.asarray(g, dtype=p.dtype) for g, p in zip(grads, self._params_flat)
+            ]
+        else:
+            grads_flat = [
+                jax.numpy.asarray(g, dtype=p.dtype)
+                for g, p in zip(jax.tree_util.tree_flatten(grads)[0], self._params_flat)
+            ]
+        with self._state_lock:
+            self._params_flat, self.opt_state = self._jitted_apply(
+                self._params_flat, self.opt_state, grads_flat
+            )
+
+    def do_averaging_round(self, timeout: Optional[float] = None, **kwargs) -> bool:
+        """Stage state to host, average with the group, load it back. Returns True on
+        success (reference state_averager averaging_round path)."""
+        host_tensors = self._host_state_tensors()
+        with self.get_tensors() as tensors:
+            for tensor, fresh in zip(tensors, host_tensors):
+                np.copyto(tensor, fresh)
+        try:
+            result = self.step(timeout=timeout, wait=True, **kwargs)
+        except Exception as e:
+            logger.warning(f"state averaging round failed: {e!r}")
+            return False
+        if result is None:
+            return False
+        with self.get_tensors() as tensors:
+            self._load_host_state_tensors([t.copy() for t in tensors])
+        return True
+
+    # ------------------------------------------------------------------ state sharing
+
+    async def _get_current_state(self) -> Tuple[Any, List[np.ndarray]]:
+        metadata = {"epoch": self.local_epoch}
+        return metadata, self._host_state_tensors()
+
+    def load_full_state_from_peers(self, timeout: Optional[float] = None) -> bool:
+        """Download params/opt-state/epoch from the best peer and adopt them
+        (reference load_state_from_peers path, state_averager.py:658-698)."""
+        result = self.load_state_from_peers(timeout=timeout)
+        if result is None:
+            return False
+        metadata, tensors = result
+        expected = len(self._params_flat) + len(self._averaged_opt_indices) + len(self.extra_tensors)
+        if len(tensors) != expected:
+            logger.warning(f"donor sent {len(tensors)} tensors, expected {expected}; ignoring")
+            return False
+        self._load_host_state_tensors(tensors)
+        if isinstance(metadata, dict) and "epoch" in metadata:
+            self.local_epoch = max(self.local_epoch, int(metadata["epoch"]))
+        logger.info(f"adopted peer state at epoch {self.local_epoch}")
+        return True

@@ -1,0 +1,188 @@
+"""Collaborative optimizer: grad averager semantics, progress tracker aggregation,
+state averager optax updates, and full multi-peer convergence on a toy task
+(scope: reference tests/test_optimizer.py)."""
+
+import threading
+import time
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hivemind_tpu.dht import DHT
+from hivemind_tpu.optim import GradientAverager, Optimizer, ProgressTracker, TrainingStateAverager
+from hivemind_tpu.utils.timed_storage import get_dht_time
+
+
+def launch_dht_swarm(n: int):
+    first = DHT(start=True)
+    maddrs = [str(m) for m in first.get_visible_maddrs()]
+    return [first] + [DHT(initial_peers=maddrs, start=True) for _ in range(n - 1)]
+
+
+def test_grad_averager_accumulation():
+    dhts = launch_dht_swarm(2)
+    try:
+        like = [np.zeros(10, np.float32)]
+        averagers = [
+            GradientAverager(like, dht=dht, prefix="gradacc", start=True,
+                             target_group_size=2, min_matchmaking_time=1.0)
+            for dht in dhts
+        ]
+        # peer 0: two microbatches of 4; peer 1: one microbatch of 12
+        averagers[0].accumulate_grads_([np.full(10, 1.0, np.float32)], batch_size=4)
+        averagers[0].accumulate_grads_([np.full(10, 2.0, np.float32)], batch_size=4)
+        averagers[1].accumulate_grads_([np.full(10, 5.0, np.float32)], batch_size=12)
+        assert averagers[0].local_samples_accumulated == 8
+        controls = [a.step(wait=False, timeout=30) for a in averagers]
+        for c in controls:
+            c.result(timeout=60)
+        # per-peer normalized grads: p0 = (1*4+2*4)/8 = 1.5 with weight 8;
+        # p1 = 5*12/12 = 5 with weight 12 -> weighted mean = (1.5*8 + 5*12)/20 = 3.6
+        for averager in averagers:
+            with averager.use_averaged_gradients() as grads:
+                assert np.allclose(grads[0], 3.6, atol=1e-4)
+        # accumulators were reset by step
+        assert all(a.local_samples_accumulated == 0 for a in averagers)
+        for a in averagers:
+            a.shutdown()
+    finally:
+        for dht in dhts:
+            dht.shutdown()
+
+
+def test_progress_tracker_aggregation():
+    dhts = launch_dht_swarm(2)
+    try:
+        trackers = [
+            ProgressTracker(dht, "trackrun", target_batch_size=100, min_refresh_period=0.2,
+                            default_refresh_period=0.5)
+            for dht in dhts
+        ]
+        trackers[0].report_local_progress(0, 30)
+        trackers[1].report_local_progress(0, 30)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if all(t.global_progress.samples_accumulated >= 60 for t in trackers):
+                break
+            time.sleep(0.3)
+        for tracker in trackers:
+            assert tracker.global_progress.samples_accumulated >= 60
+            assert tracker.global_progress.num_peers == 2
+            assert not tracker.ready_to_update_epoch or tracker.global_progress.eta_next_epoch <= get_dht_time()
+        # crossing the target flips readiness
+        trackers[0].report_local_progress(0, 80)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not trackers[1].ready_to_update_epoch:
+            time.sleep(0.3)
+        assert trackers[1].ready_to_update_epoch
+        # epoch update resets global accounting
+        for tracker in trackers:
+            tracker.update_epoch(1)
+        assert all(t.global_epoch == 1 for t in trackers)
+        for tracker in trackers:
+            tracker.shutdown()
+    finally:
+        for dht in dhts:
+            dht.shutdown()
+
+
+def test_state_averager_optax_roundtrip():
+    dht = DHT(start=True)
+    try:
+        params = {"w": jnp.ones((4, 2)), "b": jnp.zeros(2)}
+        averager = TrainingStateAverager(
+            dht=dht, optimizer=optax.sgd(0.5), params=params, prefix="statetest", start=True,
+        )
+        grads = {"w": jnp.full((4, 2), 0.2), "b": jnp.full(2, 0.4)}
+        averager.apply_optimizer_step(grads)
+        new_params = averager.params
+        assert np.allclose(new_params["w"], 1.0 - 0.5 * 0.2, atol=1e-6)
+        assert np.allclose(new_params["b"], -0.5 * 0.4, atol=1e-6)
+        # host staging round trip preserves values
+        host = averager._host_state_tensors()
+        averager._load_host_state_tensors(host)
+        assert np.allclose(averager.params["w"], new_params["w"], atol=1e-6)
+        averager.shutdown()
+    finally:
+        dht.shutdown()
+
+
+def test_optimizer_collaborative_convergence():
+    """Two peers jointly minimize a least-squares objective; epochs must stay in sync
+    and the loss must drop by >10x (the shape of reference benchmark_optimizer.py)."""
+    rng = np.random.RandomState(0)
+    true_w = rng.randn(8).astype(np.float32)
+    features = rng.randn(256, 8).astype(np.float32)
+    targets = features @ true_w
+
+    def make_loss_fn():
+        @jax.jit
+        def loss_and_grad(params, x, y):
+            def loss_fn(p):
+                pred = x @ p["w"]
+                return jnp.mean((pred - y) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            return loss, grads
+
+        return loss_and_grad
+
+    dhts = launch_dht_swarm(2)
+    results = {}
+    errors = []
+
+    def run_peer(index: int, dht: DHT):
+        try:
+            params = {"w": jnp.zeros(8, jnp.float32)}
+            opt = Optimizer(
+                dht=dht, run_id="convergence_test", target_batch_size=64,
+                params=params, optimizer=optax.sgd(0.3),
+                batch_size_per_step=16, matchmaking_time=1.5, averaging_timeout=30,
+                average_state_every=1, target_group_size=2, verbose=False,
+                tracker_opts=dict(min_refresh_period=0.3, default_refresh_period=0.5),
+            )
+            loss_and_grad = make_loss_fn()
+            rng_local = np.random.RandomState(index)
+            first_loss = last_loss = None
+            for step in range(60):
+                if opt.local_epoch >= 5:
+                    break
+                idx = rng_local.choice(len(features), 16)
+                loss, grads = loss_and_grad(opt.params, features[idx], targets[idx])
+                if first_loss is None:
+                    first_loss = float(loss)
+                last_loss = float(loss)
+                opt.step(grads)
+                # pace the loop like real compute: progress records must have time to
+                # propagate, or each peer would finish whole epochs solo
+                time.sleep(0.25)
+            results[index] = (first_loss, last_loss, opt.local_epoch, np.asarray(opt.params["w"]))
+            opt.shutdown()
+        except Exception as e:
+            import traceback
+
+            errors.append((index, e, traceback.format_exc()))
+
+    threads = [threading.Thread(target=run_peer, args=(i, dht)) for i, dht in enumerate(dhts)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    try:
+        assert not errors, f"peer failures: {errors}"
+        assert len(results) == 2
+        for index, (first_loss, last_loss, epoch, w) in results.items():
+            assert epoch >= 2, f"peer {index} stuck at epoch {epoch}"
+            assert last_loss < first_loss / 10, (
+                f"peer {index}: loss {first_loss:.4f} -> {last_loss:.4f} did not converge"
+            )
+        # state averaging keeps peers' parameters in sync
+        w0, w1 = results[0][3], results[1][3]
+        assert np.allclose(w0, w1, atol=0.05), f"peers diverged: {np.abs(w0 - w1).max()}"
+    finally:
+        for dht in dhts:
+            dht.shutdown()
