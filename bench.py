@@ -1,0 +1,116 @@
+"""Round benchmark: ALBERT-base MLM training throughput on one chip.
+
+Prints ONE JSON line: tokens/sec/chip for the flagship collaborative-pretraining
+model (fwd+bwd+optax update, bf16 compute), plus achieved MFU relative to the 35%
+north-star target (BASELINE.json: ALBERT-base tokens/sec/chip at >=35% MFU)."""
+
+import json
+import time
+
+
+def flops_per_token(config, seq_len: int) -> float:
+    """fwd+bwd FLOPs per token ~= 6 * (matmul params-equivalent per token)."""
+    h, i, L = config.hidden_size, config.intermediate_size, config.num_layers
+    per_layer = 4 * h * h + 2 * h * i  # qkv+out projections + ffn (MACs per token)
+    attention_quadratic = 2 * seq_len * h  # QK^T + PV MACs per token (x6 below -> FLOPs)
+    head = h * config.embedding_size + config.embedding_size * config.vocab_size
+    total_params_equiv = L * (per_layer + attention_quadratic) + head
+    return 6.0 * total_params_equiv
+
+
+_PEAK_BF16_FLOPS = {
+    # per-chip peak bf16 FLOP/s by device kind substring
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6": 918e12,
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, value in _PEAK_BF16_FLOPS.items():
+        if key in kind:
+            return value
+    return 197e12  # default: v5e-class
+
+
+def _tpu_reachable(timeout: float = 90.0) -> bool:
+    """Probe TPU initialization in a SUBPROCESS: if the accelerator tunnel is wedged,
+    jax.devices() hangs forever and would take the whole benchmark (and its driver)
+    with it. A hung probe is killed; the bench then falls back to CPU."""
+    import subprocess
+    import sys
+
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; assert jax.devices()[0].platform != 'cpu'"],
+            timeout=timeout,
+            capture_output=True,
+        )
+        return probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main() -> None:
+    use_tpu = _tpu_reachable()
+    import jax
+
+    if not use_tpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+
+    from hivemind_tpu.models import AlbertConfig, make_synthetic_mlm_batch, make_train_step
+
+    device = jax.devices()[0]
+    on_tpu = device.platform != "cpu"
+    batch_size, seq_len = (32, 512) if on_tpu else (4, 128)
+
+    config = AlbertConfig.base(max_position=seq_len)
+    optimizer = optax.adamw(1e-4)
+    model, train_step = make_train_step(config, optimizer)
+    batch = make_synthetic_mlm_batch(jax.random.PRNGKey(0), config, batch_size, seq_len)
+    params = model.init(jax.random.PRNGKey(1), batch["input_ids"][:1, :8])["params"]
+    opt_state = optimizer.init(params)
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    # warmup (compile)
+    loss, params, opt_state = step(params, opt_state, batch)
+    jax.block_until_ready(loss)
+    loss, params, opt_state = step(params, opt_state, batch)
+    jax.block_until_ready(loss)
+
+    num_steps = 20 if on_tpu else 5
+    start = time.perf_counter()
+    for _ in range(num_steps):
+        loss, params, opt_state = step(params, opt_state, batch)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - start
+
+    tokens = batch_size * seq_len * num_steps
+    tokens_per_sec = tokens / elapsed
+    mfu = tokens_per_sec * flops_per_token(config, seq_len) / peak_flops(device)
+    print(
+        json.dumps(
+            {
+                "metric": "albert_base_mlm_tokens_per_sec_per_chip",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(mfu / 0.35, 4),
+                "extra": {
+                    "mfu": round(mfu, 4),
+                    "device": str(getattr(device, "device_kind", device.platform)),
+                    "batch_size": batch_size,
+                    "seq_len": seq_len,
+                    "final_loss": round(float(loss), 4),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+from hivemind_tpu.models.albert import (
+    AlbertConfig,
+    AlbertForMaskedLM,
+    AlbertLayer,
+    make_synthetic_mlm_batch,
+    make_train_step,
+    mlm_loss,
+)

@@ -1,0 +1,174 @@
+"""ALBERT-style masked-LM — the flagship collaborative-pretraining model
+(capability parity: the reference's examples/albert recipe targets HF ALBERT on
+torch; this is an own flax implementation, TPU-first: bf16 compute, layer-shared
+encoder on the MXU, pluggable attention core that switches to ring attention when the
+mesh has a sequence-parallel axis).
+
+ALBERT signature features: factorized embeddings (vocab → embedding_size →
+hidden_size) and cross-layer parameter sharing (one transformer block applied
+num_layers times)."""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from hivemind_tpu.parallel.ring_attention import plain_attention, ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class AlbertConfig:
+    vocab_size: int = 30000
+    embedding_size: int = 128
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    dtype: Any = jnp.bfloat16
+    # sequence parallelism: when mesh is set and its 'sp' axis > 1, attention runs as
+    # ring attention sharded over the sequence (mask support: full sequences only)
+    mesh: Optional[Any] = None
+
+    @property
+    def head_dim(self) -> int:
+        assert self.hidden_size % self.num_heads == 0
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def base(cls, **overrides) -> "AlbertConfig":
+        return cls(**overrides)
+
+    @classmethod
+    def tiny(cls, **overrides) -> "AlbertConfig":
+        defaults = dict(
+            vocab_size=1024, embedding_size=32, hidden_size=64, num_layers=2,
+            num_heads=4, intermediate_size=128, max_position=128,
+        )
+        defaults.update(overrides)
+        return cls(**defaults)
+
+
+def _attention_core(config: AlbertConfig, q, k, v, mask):
+    mesh = config.mesh
+    if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        spec = P("dp", "sp", "tp" if mesh.shape.get("tp", 1) > 1 else None, None)
+        core = shard_map(
+            partial(ring_attention, axis_name="sp"),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            
+        )
+        return core(q, k, v)
+    return plain_attention(q, k, v, mask)
+
+
+class AlbertLayer(nn.Module):
+    """One shared transformer block (post-layernorm, gelu FFN)."""
+
+    config: AlbertConfig
+
+    @nn.compact
+    def __call__(self, hidden: jax.Array, mask: Optional[jax.Array]) -> jax.Array:
+        cfg = self.config
+        batch, seq, _ = hidden.shape
+        dense = partial(nn.Dense, dtype=cfg.dtype, param_dtype=jnp.float32)
+        q = dense(cfg.hidden_size, name="query")(hidden).reshape(batch, seq, cfg.num_heads, cfg.head_dim)
+        k = dense(cfg.hidden_size, name="key")(hidden).reshape(batch, seq, cfg.num_heads, cfg.head_dim)
+        v = dense(cfg.hidden_size, name="value")(hidden).reshape(batch, seq, cfg.num_heads, cfg.head_dim)
+        context = _attention_core(cfg, q, k, v, mask)
+        attn_out = dense(cfg.hidden_size, name="attention_out")(context.reshape(batch, seq, -1))
+        hidden = nn.LayerNorm(dtype=cfg.dtype, name="attention_norm")(hidden + attn_out)
+        up = dense(cfg.intermediate_size, name="ffn_up")(hidden)
+        down = dense(cfg.hidden_size, name="ffn_down")(jax.nn.gelu(up))
+        return nn.LayerNorm(dtype=cfg.dtype, name="ffn_norm")(hidden + down)
+
+
+class AlbertForMaskedLM(nn.Module):
+    config: AlbertConfig
+
+    def setup(self):
+        cfg = self.config
+        self.word_embeddings = nn.Embed(
+            cfg.vocab_size, cfg.embedding_size, dtype=cfg.dtype, param_dtype=jnp.float32,
+            name="word_embeddings",
+        )
+        self.position_embeddings = self.param(
+            "position_embeddings",
+            nn.initializers.normal(0.02),
+            (cfg.max_position, cfg.embedding_size),
+            jnp.float32,
+        )
+        self.embedding_norm = nn.LayerNorm(dtype=cfg.dtype, name="embedding_norm")
+        self.embedding_projection = nn.Dense(
+            cfg.hidden_size, dtype=cfg.dtype, param_dtype=jnp.float32, name="embedding_projection"
+        )
+        self.shared_layer = AlbertLayer(cfg, name="shared_layer")
+        self.mlm_transform = nn.Dense(
+            cfg.embedding_size, dtype=cfg.dtype, param_dtype=jnp.float32, name="mlm_transform"
+        )
+        self.mlm_norm = nn.LayerNorm(dtype=cfg.dtype, name="mlm_norm")
+        self.mlm_bias = self.param("mlm_bias", nn.initializers.zeros, (cfg.vocab_size,), jnp.float32)
+
+    def encode(self, input_ids: jax.Array, attention_mask: Optional[jax.Array] = None) -> jax.Array:
+        cfg = self.config
+        seq = input_ids.shape[1]
+        x = self.word_embeddings(input_ids) + self.position_embeddings[None, :seq].astype(cfg.dtype)
+        x = self.embedding_projection(self.embedding_norm(x))
+        for _ in range(cfg.num_layers):  # cross-layer parameter sharing
+            x = self.shared_layer(x, attention_mask)
+        return x
+
+    def __call__(self, input_ids: jax.Array, attention_mask: Optional[jax.Array] = None) -> jax.Array:
+        """Returns MLM logits [batch, seq, vocab] (float32 for a stable softmax)."""
+        hidden = self.encode(input_ids, attention_mask)
+        transformed = self.mlm_norm(jax.nn.gelu(self.mlm_transform(hidden)))
+        logits = self.word_embeddings.attend(transformed)  # tied decoder
+        return logits.astype(jnp.float32) + self.mlm_bias
+
+
+def mlm_loss(logits: jax.Array, labels: jax.Array, mlm_mask: jax.Array) -> jax.Array:
+    """Masked cross-entropy: mlm_mask selects the positions that were masked out."""
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    label_ll = jnp.take_along_axis(log_probs, labels[..., None], axis=-1)[..., 0]
+    mask = mlm_mask.astype(jnp.float32)
+    return -(label_ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def make_train_step(config: AlbertConfig, optimizer):
+    """A jittable (params, opt_state, batch) -> (loss, params, opt_state) step.
+    ``batch``: dict(input_ids, labels, mlm_mask)."""
+    import optax
+
+    model = AlbertForMaskedLM(config)
+
+    def train_step(params, opt_state, batch):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, batch["input_ids"])
+            return mlm_loss(logits, batch["labels"], batch["mlm_mask"])
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return loss, params, opt_state
+
+    return model, train_step
+
+
+def make_synthetic_mlm_batch(rng: jax.Array, config: AlbertConfig, batch_size: int, seq_len: int):
+    """Deterministic synthetic MLM data for benchmarks/tests (15% masking)."""
+    ids_key, mask_key = jax.random.split(rng)
+    labels = jax.random.randint(ids_key, (batch_size, seq_len), 0, config.vocab_size)
+    mlm_mask = jax.random.bernoulli(mask_key, 0.15, (batch_size, seq_len))
+    mask_token = jnp.asarray(config.vocab_size - 1)
+    input_ids = jnp.where(mlm_mask, mask_token, labels)
+    return {"input_ids": input_ids, "labels": labels, "mlm_mask": mlm_mask}

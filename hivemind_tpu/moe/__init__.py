@@ -1,0 +1,18 @@
+from hivemind_tpu.moe.client import (
+    MoEBeamSearcher,
+    RemoteExpert,
+    RemoteExpertWorker,
+    RemoteMixtureOfExperts,
+    RemoteSwitchMixtureOfExperts,
+)
+from hivemind_tpu.moe.expert_uid import ExpertInfo, ExpertUID, is_valid_prefix, is_valid_uid, split_uid
+from hivemind_tpu.moe.server import (
+    ConnectionHandler,
+    ModuleBackend,
+    Runtime,
+    Server,
+    background_server,
+    declare_experts,
+    get_experts,
+    register_expert_class,
+)

@@ -1,0 +1,3 @@
+from hivemind_tpu.moe.client.beam_search import MoEBeamSearcher
+from hivemind_tpu.moe.client.expert import RemoteExpert, RemoteExpertWorker
+from hivemind_tpu.moe.client.moe import RemoteMixtureOfExperts, RemoteSwitchMixtureOfExperts

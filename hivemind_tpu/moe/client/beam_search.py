@@ -1,0 +1,145 @@
+"""MoEBeamSearcher: find the k best experts on the UID grid via left-to-right beam
+search over DHT prefix dictionaries (capability parity: reference
+hivemind/moe/client/beam_search.py:27-401). Runs inside the DHT's event loop via
+dht.run_coroutine (reference beam_search.py:106-117), with negative caching of dead
+prefixes (reference 60-74,152-160)."""
+
+from __future__ import annotations
+
+import heapq
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from hivemind_tpu.dht import DHT
+from hivemind_tpu.moe.expert_uid import UID_DELIMITER, ExpertInfo, is_valid_prefix
+from hivemind_tpu.p2p import PeerID
+from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.timed_storage import TimedStorage, get_dht_time
+
+logger = get_logger(__name__)
+
+
+class MoEBeamSearcher:
+    """:param uid_prefix: grid name, e.g. 'ffn.' (trailing delimiter required)
+    :param grid_size: number of indices per grid dimension"""
+
+    def __init__(
+        self,
+        dht: DHT,
+        uid_prefix: str,
+        grid_size: Sequence[int],
+        *,
+        num_workers: Optional[int] = None,
+        negative_cache_time: float = 30.0,
+    ):
+        if not uid_prefix.endswith(UID_DELIMITER):
+            uid_prefix += UID_DELIMITER
+        assert is_valid_prefix(uid_prefix), f"invalid prefix {uid_prefix!r}"
+        self.dht = dht
+        self.uid_prefix = uid_prefix
+        self.grid_size = tuple(grid_size)
+        self.negative_cache_time = negative_cache_time
+        self._negative_cache: TimedStorage[str, bool] = TimedStorage()
+
+    def find_best_experts(self, grid_scores: Sequence[np.ndarray], beam_size: int) -> List[ExpertInfo]:
+        """``grid_scores[d][i]`` scores coordinate i of dimension d for ONE sample;
+        returns up to beam_size experts sorted by total score (descending)."""
+        batched = self.batch_find_best_experts([np.asarray(s)[None] for s in grid_scores], beam_size)
+        return batched[0]
+
+    def batch_find_best_experts(
+        self, batch_grid_scores: Sequence[np.ndarray], beam_size: int
+    ) -> List[List[ExpertInfo]]:
+        """``batch_grid_scores[d][b, i]``: per-sample scores. One DHT pass serves the
+        whole batch (prefix fetches are shared across samples)."""
+        scores = [np.asarray(dim_scores, np.float32) for dim_scores in batch_grid_scores]
+        assert len(scores) == len(self.grid_size)
+
+        async def _search(dht_obj, node) -> List[List[ExpertInfo]]:
+            return await self._find_best_experts_async(node, scores, beam_size)
+
+        return self.dht.run_coroutine(_search)
+
+    async def _find_best_experts_async(self, node, scores, beam_size: int) -> List[List[ExpertInfo]]:
+        batch_size = scores[0].shape[0]
+        # per-sample beams: list of (neg_total_score, prefix_without_trailing_delim)
+        beams: List[List[Tuple[float, str]]] = [
+            [(0.0, self.uid_prefix.rstrip(UID_DELIMITER))] for _ in range(batch_size)
+        ]
+        for dim, dim_scores in enumerate(scores):
+            # gather every active prefix across the batch (deduplicated)
+            active: Dict[str, None] = {}
+            for beam in beams:
+                for _neg_score, prefix in beam:
+                    if prefix not in self._negative_cache:
+                        active[prefix] = None
+            prefix_coords = await self._fetch_prefix_dicts(node, list(active.keys()))
+            new_beams: List[List[Tuple[float, str]]] = []
+            for sample in range(batch_size):
+                candidates: List[Tuple[float, str]] = []
+                for neg_score, prefix in beams[sample]:
+                    coords = prefix_coords.get(prefix, {})
+                    if not coords:
+                        continue
+                    for coord in coords:
+                        if not (0 <= coord < self.grid_size[dim]):
+                            continue
+                        score = -neg_score + float(dim_scores[sample, coord])
+                        candidates.append((-score, f"{prefix}{UID_DELIMITER}{coord}"))
+                new_beams.append(heapq.nsmallest(beam_size, candidates))
+            beams = new_beams
+
+        # resolve leaves to peers
+        leaf_uids: Dict[str, None] = {}
+        for beam in beams:
+            for _neg, uid in beam:
+                leaf_uids[uid] = None
+        uid_to_peer = await self._resolve_leaves(node, list(leaf_uids.keys()))
+        results: List[List[ExpertInfo]] = []
+        for beam in beams:
+            sample_result = []
+            for neg_score, uid in sorted(beam):
+                peer_id = uid_to_peer.get(uid)
+                if peer_id is not None:
+                    sample_result.append(ExpertInfo(uid, peer_id))
+            results.append(sample_result)
+        return results
+
+    async def _fetch_prefix_dicts(self, node, prefixes: List[str]) -> Dict[str, Dict[int, None]]:
+        if not prefixes:
+            return {}
+        found = await node.get_many(prefixes)
+        out: Dict[str, Dict[int, None]] = {}
+        for prefix in prefixes:
+            entry = found.get(prefix)
+            coords: Dict[int, None] = {}
+            if entry is not None and isinstance(entry.value, dict):
+                for subkey in entry.value:
+                    if isinstance(subkey, int):
+                        coords[subkey] = None
+            if coords:
+                out[prefix] = coords
+            else:
+                # dead prefix: don't ask again for a while (reference negative caching)
+                self._negative_cache.store(prefix, True, get_dht_time() + self.negative_cache_time)
+        return out
+
+    async def _resolve_leaves(self, node, uids: List[str]) -> Dict[str, PeerID]:
+        if not uids:
+            return {}
+        found = await node.get_many(uids)
+        out = {}
+        for uid in uids:
+            entry = found.get(uid)
+            if entry is not None and isinstance(entry.value, str):
+                try:
+                    out[uid] = PeerID.from_base58(entry.value)
+                except Exception:
+                    continue
+        return out
+
+    def get_initial_beam(self, dim_scores: np.ndarray, beam_size: int):
+        """Compatibility helper: top-scoring first-dimension prefixes."""
+        order = np.argsort(-np.asarray(dim_scores))[:beam_size]
+        return [(float(dim_scores[i]), f"{self.uid_prefix}{i}{UID_DELIMITER}") for i in order]

@@ -1,0 +1,185 @@
+"""RemoteMixtureOfExperts: route each input to its top-k experts across the swarm and
+mix their outputs (capability parity: reference hivemind/moe/client/moe.py:25-442).
+
+Host-orchestrated: gating + mixing are differentiable jax ops; expert calls go through
+RemoteExpert's custom_vjp (RPC on both passes). Fault tolerance mirrors the
+reference's _RemoteCallMany: experts that fail are masked out of the softmax, and the
+forward proceeds if at least ``k_min`` experts responded per sample."""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hivemind_tpu.dht import DHT
+from hivemind_tpu.moe.client.beam_search import MoEBeamSearcher
+from hivemind_tpu.moe.client.expert import RemoteExpert
+from hivemind_tpu.moe.expert_uid import ExpertInfo
+from hivemind_tpu.p2p import P2P
+from hivemind_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class RemoteMixtureOfExperts:
+    """:param grid_size: experts live on a grid of this shape under uid_prefix
+    :param k_best: experts per sample
+    :param k_min: minimum experts that must respond (reference k_min semantics)"""
+
+    def __init__(
+        self,
+        *,
+        dht: DHT,
+        in_features: int,
+        grid_size: Sequence[int],
+        uid_prefix: str,
+        k_best: int = 4,
+        k_min: int = 1,
+        beam_size: Optional[int] = None,
+        seed: int = 0,
+    ):
+        self.dht = dht
+        self.p2p: P2P = dht.node.p2p
+        self.grid_size = tuple(grid_size)
+        self.k_best, self.k_min = k_best, k_min
+        self.beam_size = beam_size if beam_size is not None else k_best * 2
+        self.beam_searcher = MoEBeamSearcher(dht, uid_prefix, grid_size)
+        rng = np.random.RandomState(seed)
+        # the trainable gating projection (reference: nn.Linear at moe.py:74)
+        self.proj = jnp.asarray(rng.randn(in_features, sum(grid_size)) * 0.01, jnp.float32)
+        self._experts: Dict[str, RemoteExpert] = {}
+
+    def _get_expert(self, info: ExpertInfo) -> RemoteExpert:
+        if info.uid not in self._experts:
+            self._experts[info.uid] = RemoteExpert(info, self.p2p)
+        return self._experts[info.uid]
+
+    def _split_scores(self, flat_scores: jax.Array) -> List[jax.Array]:
+        out, offset = [], 0
+        for size in self.grid_size:
+            out.append(flat_scores[:, offset : offset + size])
+            offset += size
+        return out
+
+    def _uid_coords(self, uid: str) -> List[int]:
+        """Grid coordinates = the part of the uid after the grid prefix (the prefix
+        itself may contain numeric components, e.g. per-layer grids 'ffn.3.')."""
+        prefix = self.beam_searcher.uid_prefix
+        assert uid.startswith(prefix), (uid, prefix)
+        return [int(c) for c in uid[len(prefix):].split(".")]
+
+    def _expert_logit(self, grid_scores: List[jax.Array], sample: int, uid: str) -> jax.Array:
+        coords = self._uid_coords(uid)
+        return sum(grid_scores[d][sample, c] for d, c in enumerate(coords))
+
+    def __call__(self, x: jax.Array, proj: Optional[jax.Array] = None) -> jax.Array:
+        """x: [batch, in_features]. Returns the expert mixture [batch, out_features].
+        Eager-mode API (expert selection is data-dependent host orchestration)."""
+        proj = proj if proj is not None else self.proj
+        grid_scores = self._split_scores(x @ proj)
+        chosen = self.beam_searcher.batch_find_best_experts(
+            [np.asarray(jax.lax.stop_gradient(s)) for s in grid_scores], self.beam_size
+        )
+        return self._mix(x, grid_scores, chosen)
+
+    def _mix(self, x: jax.Array, grid_scores: List[jax.Array], chosen: List[List[ExpertInfo]]) -> jax.Array:
+        batch_size = x.shape[0]
+        # group samples by expert so each expert gets ONE batched call
+        expert_to_samples: Dict[str, List[int]] = {}
+        sample_experts: List[List[ExpertInfo]] = []
+        for sample in range(batch_size):
+            infos = chosen[sample][: self.k_best]
+            sample_experts.append(infos)
+            for info in infos:
+                expert_to_samples.setdefault(info.uid, []).append(sample)
+        if not expert_to_samples:
+            raise RuntimeError("beam search found no experts; is any server declared on this grid?")
+
+        uid_to_info = {}
+        for sample_infos in sample_experts:
+            for info in sample_infos:
+                uid_to_info[info.uid] = info
+
+        # fault-tolerant scatter: ALL experts are called concurrently (the reference's
+        # _RemoteCallMany, moe.py:114-139); a slow expert costs max(), not sum(), and
+        # failed experts are masked out of the softmax
+        expert_outputs: Dict[str, jax.Array] = {}
+        expert_sample_pos: Dict[str, Dict[int, int]] = {}
+
+        def _call_one(uid: str, samples: List[int]):
+            expert = self._get_expert(uid_to_info[uid])
+            sub = x[jnp.asarray(samples)]
+            return jax.block_until_ready(expert(sub))
+
+        with ThreadPoolExecutor(max_workers=max(len(expert_to_samples), 1)) as pool:
+            futures = {
+                uid: pool.submit(_call_one, uid, samples)
+                for uid, samples in expert_to_samples.items()
+            }
+            for uid, future in futures.items():
+                try:
+                    expert_outputs[uid] = future.result()
+                    expert_sample_pos[uid] = {s: i for i, s in enumerate(expert_to_samples[uid])}
+                except Exception as e:
+                    logger.warning(f"expert {uid} failed: {e!r}; masking it out")
+
+        if not expert_outputs:
+            raise RuntimeError("all chosen experts failed")
+
+        outputs = []
+        for sample in range(batch_size):
+            live: List[Tuple[jax.Array, jax.Array]] = []  # (logit, output)
+            for info in sample_experts[sample]:
+                if info.uid in expert_outputs:
+                    position = expert_sample_pos[info.uid][sample]
+                    live.append(
+                        (self._expert_logit(grid_scores, sample, info.uid), expert_outputs[info.uid][position])
+                    )
+            if len(live) < self.k_min:
+                raise RuntimeError(f"sample {sample}: only {len(live)} experts responded (k_min={self.k_min})")
+            logits = jnp.stack([logit for logit, _ in live])
+            weights = jax.nn.softmax(logits)
+            stacked = jnp.stack([out for _, out in live])
+            outputs.append(jnp.einsum("e,ed->d", weights, stacked))
+        return jnp.stack(outputs)
+
+
+class RemoteSwitchMixtureOfExperts(RemoteMixtureOfExperts):
+    """Switch-Transformer routing: top-1 expert, multiplicative jitter on inputs to
+    the gate, and a utilization EMA for load-balancing diagnostics (capability
+    parity: reference hivemind/moe/client/switch_moe.py:17-225)."""
+
+    def __init__(self, *, jitter_eps: float = 1e-2, utilization_alpha: float = 0.01, **kwargs):
+        kwargs.setdefault("k_best", 1)
+        kwargs.setdefault("k_min", 1)
+        super().__init__(**kwargs)
+        self.jitter_eps = jitter_eps
+        self.utilization_alpha = utilization_alpha
+        self.grid_utilization = [np.full(size, 1.0 / size, np.float64) for size in self.grid_size]
+        self._jitter_rng = np.random.RandomState(self.beam_size)
+
+    def __call__(self, x: jax.Array, proj: Optional[jax.Array] = None) -> jax.Array:
+        # jitter perturbs the GATING scores only; experts see the original input and
+        # only ONE beam search runs (reference switch_moe.py:78-79,126)
+        noise = self._jitter_rng.uniform(
+            1 - self.jitter_eps, 1 + self.jitter_eps, size=(x.shape[0], 1)
+        ).astype(np.float32)
+        proj = proj if proj is not None else self.proj
+        grid_scores = self._split_scores((x * jnp.asarray(noise)) @ proj)
+        chosen = self.beam_searcher.batch_find_best_experts(
+            [np.asarray(jax.lax.stop_gradient(s)) for s in grid_scores], self.beam_size
+        )
+        self._update_utilization(chosen)
+        return self._mix(x, grid_scores, chosen)
+
+    def _update_utilization(self, chosen: List[List[ExpertInfo]]) -> None:
+        alpha = self.utilization_alpha
+        for sample_infos in chosen:
+            for info in sample_infos[:1]:  # top-1 routing
+                for dim, coord in enumerate(self._uid_coords(info.uid)):
+                    self.grid_utilization[dim] *= 1 - alpha
+                    self.grid_utilization[dim][coord] += alpha

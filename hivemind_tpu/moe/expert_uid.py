@@ -1,0 +1,39 @@
+"""Expert UID grid naming (capability parity: reference hivemind/moe/expert_uid.py:8-37).
+
+Experts live on a named grid: ``prefix.i.j.k`` — each dot-separated integer indexes one
+grid dimension. Beam search walks prefixes left to right."""
+
+from __future__ import annotations
+
+import re
+from typing import NamedTuple, Tuple
+
+from hivemind_tpu.p2p import PeerID
+
+ExpertUID = str
+ExpertPrefix = str
+
+UID_DELIMITER = "."
+FLAT_EXPERT = -1
+UID_PATTERN = re.compile(r"^(([^.])+)([.](?:[0]|([1-9]([0-9]*))))+$")
+PREFIX_PATTERN = re.compile(r"^(([^.])+)([.](?:[0]|([1-9]([0-9]*))))*[.]$")
+
+
+def is_valid_uid(maybe_uid: str) -> bool:
+    return bool(UID_PATTERN.fullmatch(maybe_uid))
+
+
+def is_valid_prefix(maybe_prefix: str) -> bool:
+    return bool(PREFIX_PATTERN.fullmatch(maybe_prefix))
+
+
+def split_uid(uid_or_prefix: str) -> Tuple[ExpertPrefix, int]:
+    """'ffn.5.12' -> ('ffn.5.', 12)"""
+    uid_or_prefix = uid_or_prefix.rstrip(UID_DELIMITER)
+    pivot = uid_or_prefix.rindex(UID_DELIMITER) + 1
+    return uid_or_prefix[:pivot], int(uid_or_prefix[pivot:])
+
+
+class ExpertInfo(NamedTuple):
+    uid: ExpertUID
+    peer_id: PeerID

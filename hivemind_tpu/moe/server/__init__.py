@@ -1,0 +1,8 @@
+from hivemind_tpu.moe.server.checkpoints import CheckpointSaver, load_experts, store_experts
+from hivemind_tpu.moe.server.connection_handler import ConnectionHandler
+from hivemind_tpu.moe.server.dht_handler import declare_experts, get_experts
+from hivemind_tpu.moe.server.layers import register_expert_class
+from hivemind_tpu.moe.server.module_backend import ModuleBackend
+from hivemind_tpu.moe.server.runtime import Runtime
+from hivemind_tpu.moe.server.server import Server, background_server
+from hivemind_tpu.moe.server.task_pool import TaskPool

@@ -1,0 +1,113 @@
+"""Expert RPC endpoints (capability parity: reference
+hivemind/moe/server/connection_handler.py:22-177 — there N forked handler processes;
+here one asyncio servicer feeding the task pools directly)."""
+
+from __future__ import annotations
+
+from typing import AsyncIterator, Dict, List
+
+import numpy as np
+
+from hivemind_tpu.compression import (
+    CompressionType,
+    deserialize_tensor,
+    deserialize_tensor_stream,
+    serialize_tensor,
+    split_tensor_for_streaming,
+)
+from hivemind_tpu.moe.server.module_backend import ModuleBackend
+from hivemind_tpu.moe.server.task_pool import TaskPool
+from hivemind_tpu.p2p import P2P, P2PContext, ServicerBase
+from hivemind_tpu.proto import runtime_pb2
+from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.serializer import MSGPackSerializer
+
+logger = get_logger(__name__)
+
+_STREAM_CHUNK = 2**20  # 1 MiB chunks inside stream replies
+
+
+class ConnectionHandler(ServicerBase):
+    def __init__(self, backends: Dict[str, ModuleBackend]):
+        self.backends = backends
+        self.forward_pools: Dict[str, TaskPool] = {}
+        self.backward_pools: Dict[str, TaskPool] = {}
+        for name, backend in backends.items():
+            self.forward_pools[name] = TaskPool(
+                backend.forward, f"{name}_forward", max_batch_size=backend.max_batch_size
+            )
+            self.backward_pools[name] = TaskPool(
+                backend.backward, f"{name}_backward", max_batch_size=backend.max_batch_size
+            )
+
+    def all_pools(self) -> List[TaskPool]:
+        return list(self.forward_pools.values()) + list(self.backward_pools.values())
+
+    # ------------------------------------------------------------------ RPCs
+
+    async def rpc_info(self, request: runtime_pb2.ExpertUID, context: P2PContext) -> runtime_pb2.ExpertInfoResponse:
+        backend = self.backends.get(request.uid)
+        if backend is None:
+            raise KeyError(f"unknown expert {request.uid!r}")
+        return runtime_pb2.ExpertInfoResponse(serialized_info=MSGPackSerializer.dumps(backend.get_info()))
+
+    async def _run_forward(self, uid: str, tensors: List[np.ndarray]) -> List[np.ndarray]:
+        pool = self.forward_pools.get(uid)
+        if pool is None:
+            raise KeyError(f"unknown expert {uid!r}")
+        return await pool.submit_task(tensors[0])
+
+    async def _run_backward(self, uid: str, tensors: List[np.ndarray]) -> List[np.ndarray]:
+        pool = self.backward_pools.get(uid)
+        if pool is None:
+            raise KeyError(f"unknown expert {uid!r}")
+        assert len(tensors) >= 2, "backward needs (inputs, grad_outputs)"
+        return await pool.submit_task(tensors[0], tensors[1])
+
+    async def rpc_forward(self, request: runtime_pb2.ExpertRequest, context: P2PContext) -> runtime_pb2.ExpertResponse:
+        inputs = [deserialize_tensor(t) for t in request.tensors]
+        outputs = await self._run_forward(request.uid, inputs)
+        return runtime_pb2.ExpertResponse(tensors=[serialize_tensor(o) for o in outputs])
+
+    async def rpc_backward(self, request: runtime_pb2.ExpertRequest, context: P2PContext) -> runtime_pb2.ExpertResponse:
+        inputs = [deserialize_tensor(t) for t in request.tensors]
+        grads = await self._run_backward(request.uid, inputs)
+        return runtime_pb2.ExpertResponse(tensors=[serialize_tensor(g) for g in grads])
+
+    async def rpc_forward_stream(
+        self, requests: AsyncIterator[runtime_pb2.ExpertRequest], context: P2PContext
+    ) -> AsyncIterator[runtime_pb2.ExpertResponse]:
+        uid, tensors = await self._collect_stream(requests)
+        outputs = await self._run_forward(uid, tensors)
+        for message in self._stream_response(outputs):
+            yield message
+
+    async def rpc_backward_stream(
+        self, requests: AsyncIterator[runtime_pb2.ExpertRequest], context: P2PContext
+    ) -> AsyncIterator[runtime_pb2.ExpertResponse]:
+        uid, tensors = await self._collect_stream(requests)
+        grads = await self._run_backward(uid, tensors)
+        for message in self._stream_response(grads):
+            yield message
+
+    @staticmethod
+    async def _collect_stream(requests: AsyncIterator[runtime_pb2.ExpertRequest]):
+        uid = None
+
+        async def parts():
+            nonlocal uid
+            async for request in requests:
+                if uid is None and request.uid:
+                    uid = request.uid
+                yield list(request.tensors)
+
+        tensors = await deserialize_tensor_stream(parts())
+        assert uid is not None, "stream carried no expert uid"
+        return uid, tensors
+
+    @staticmethod
+    def _stream_response(outputs: List[np.ndarray]):
+        for out in outputs:
+            serialized = serialize_tensor(out)
+            for chunk in split_tensor_for_streaming(serialized, _STREAM_CHUNK):
+                yield runtime_pb2.ExpertResponse(tensors=[chunk])

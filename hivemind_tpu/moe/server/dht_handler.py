@@ -1,0 +1,73 @@
+"""Expert discovery records (capability parity: reference
+hivemind/moe/server/dht_handler.py:22-108): an expert's UID and EVERY prefix of it are
+stored as dictionary subkeys, which is what makes left-to-right beam search over the
+grid possible."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from hivemind_tpu.dht import DHT
+from hivemind_tpu.moe.expert_uid import (
+    UID_DELIMITER,
+    ExpertInfo,
+    ExpertPrefix,
+    ExpertUID,
+    is_valid_uid,
+    split_uid,
+)
+from hivemind_tpu.p2p import PeerID
+from hivemind_tpu.utils.timed_storage import DHTExpiration, get_dht_time
+
+
+def declare_experts(
+    dht: DHT, uids: Sequence[ExpertUID], expiration_time: Optional[DHTExpiration] = None, wait: bool = True
+):
+    """Store this peer's experts: for 'ffn.5.12' store subkey 5 under 'ffn.' and
+    subkey 12 under 'ffn.5.' plus the leaf record."""
+    expiration_time = expiration_time if expiration_time is not None else get_dht_time() + 300
+    peer_b58 = dht.peer_id.to_base58()
+
+    async def _declare(dht_obj, node):
+        keys, values, subkeys, expirations = [], [], [], []
+        for uid in uids:
+            assert is_valid_uid(uid), f"invalid expert uid {uid!r}"
+            keys.append(uid)
+            subkeys.append(None)
+            values.append(peer_b58)
+            expirations.append(expiration_time)
+            prefix = uid
+            while True:
+                prefix, coord = split_uid(prefix)
+                keys.append(prefix.rstrip(UID_DELIMITER))
+                subkeys.append(coord)
+                values.append(peer_b58)
+                expirations.append(expiration_time)
+                if UID_DELIMITER not in prefix.rstrip(UID_DELIMITER):
+                    break  # reached the grid root (e.g. 'ffn_test')
+        return await node.store_many(keys, values, expirations, subkeys=subkeys)
+
+    result = dht.run_coroutine(_declare, return_future=not wait)
+    return result
+
+
+def get_experts(
+    dht: DHT, uids: Sequence[ExpertUID], expiration_time: Optional[DHTExpiration] = None, wait: bool = True
+):
+    """Resolve expert UIDs to ExpertInfo(uid, peer_id) (or None if not found)."""
+
+    async def _get(dht_obj, node) -> List[Optional[ExpertInfo]]:
+        found = await node.get_many(list(uids))
+        out: List[Optional[ExpertInfo]] = []
+        for uid in uids:
+            entry = found.get(uid)
+            if entry is None or not isinstance(entry.value, str):
+                out.append(None)
+                continue
+            try:
+                out.append(ExpertInfo(uid, PeerID.from_base58(entry.value)))
+            except Exception:
+                out.append(None)
+        return out
+
+    return dht.run_coroutine(_get, return_future=not wait)

@@ -1,0 +1,13 @@
+"""Expert layer registry (capability parity: reference hivemind/moe/server/layers/).
+
+``@register_expert_class(name, sample_input_fn)`` registers a flax module factory; the
+sample input (batch-size-agnostic) defines the expert's I/O schema."""
+
+from hivemind_tpu.moe.server.layers.common import (
+    FeedforwardExpert,
+    NopExpert,
+    TransformerExpert,
+    name_to_block,
+    name_to_input,
+    register_expert_class,
+)

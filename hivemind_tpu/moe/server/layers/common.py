@@ -1,0 +1,81 @@
+"""Built-in expert blocks + registry (capability parity: reference
+hivemind/moe/server/layers/common.py:18-31 'ffn', transformer encoder block, 'nop';
+custom_experts.py:35 register_expert_class)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+name_to_block: Dict[str, Callable] = {}
+name_to_input: Dict[str, Callable] = {}
+
+
+def register_expert_class(name: str, sample_input: Callable[[int, int], np.ndarray]):
+    """Register a flax module factory under ``name``; ``sample_input(batch, hid)``
+    builds a schema-defining dummy input."""
+
+    def decorator(factory):
+        assert name not in name_to_block, f"expert class {name!r} already registered"
+        name_to_block[name] = factory
+        name_to_input[name] = sample_input
+        return factory
+
+    return decorator
+
+
+class FeedforwardExpert(nn.Module):
+    """hid -> 4*hid -> hid feedforward with layernorm (the reference's benchmark
+    'ffn' expert shape)."""
+
+    hidden_dim: int
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(self.hidden_dim * 4, dtype=jnp.bfloat16, param_dtype=jnp.float32)(x)
+        h = jax.nn.gelu(h)
+        h = nn.Dense(self.hidden_dim, dtype=jnp.bfloat16, param_dtype=jnp.float32)(h)
+        return nn.LayerNorm(dtype=jnp.bfloat16)(x + h).astype(jnp.float32)
+
+
+class TransformerExpert(nn.Module):
+    """One post-norm transformer encoder block operating on [batch, seq, hid]."""
+
+    hidden_dim: int
+    num_heads: int = 8
+
+    @nn.compact
+    def __call__(self, x):
+        from hivemind_tpu.parallel.ring_attention import plain_attention
+
+        batch, seq, hid = x.shape
+        head_dim = hid // self.num_heads
+        dense = lambda n, name: nn.Dense(n, dtype=jnp.bfloat16, param_dtype=jnp.float32, name=name)
+        q = dense(hid, "query")(x).reshape(batch, seq, self.num_heads, head_dim)
+        k = dense(hid, "key")(x).reshape(batch, seq, self.num_heads, head_dim)
+        v = dense(hid, "value")(x).reshape(batch, seq, self.num_heads, head_dim)
+        attn = dense(hid, "attention_out")(plain_attention(q, k, v).reshape(batch, seq, hid))
+        x = nn.LayerNorm(dtype=jnp.bfloat16)(x + attn)
+        h = dense(4 * hid, "ffn_up")(x)
+        h = dense(hid, "ffn_down")(jax.nn.gelu(h))
+        return nn.LayerNorm(dtype=jnp.bfloat16)(x + h).astype(jnp.float32)
+
+
+class NopExpert(nn.Module):
+    """Identity with a dummy parameter (reference 'nop' expert for transport tests)."""
+
+    hidden_dim: int
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, ())
+        return x * scale
+
+
+register_expert_class("ffn", lambda batch, hid: np.zeros((batch, hid), np.float32))(FeedforwardExpert)
+register_expert_class("transformer", lambda batch, hid: np.zeros((batch, 64, hid), np.float32))(TransformerExpert)
+register_expert_class("nop", lambda batch, hid: np.zeros((batch, hid), np.float32))(NopExpert)

@@ -1,0 +1,136 @@
+"""ModuleBackend: one expert = a flax module + optax optimizer behind jitted apply
+functions (capability parity: reference hivemind/moe/server/module_backend.py:19-200).
+
+TPU-first: instead of the reference's dynamic torch batches, inputs are padded to
+power-of-two buckets so XLA compiles one executable per bucket; backward re-derives
+the forward under jax.vjp and applies the optimizer update in the same jitted call
+(the reference's on_backward semantics, module_backend.py:156-165)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hivemind_tpu.compression import CompressionType
+from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.tensor_descr import BatchTensorDescriptor
+
+logger = get_logger(__name__)
+
+
+def bucket_batch_size(n: int, max_batch_size: int) -> int:
+    """Next power of two ≥ n (capped): static shapes for XLA."""
+    bucket = 1
+    while bucket < n:
+        bucket *= 2
+    return min(bucket, max(max_batch_size, n))
+
+
+class ModuleBackend:
+    """See module docstring.
+
+    :param module: a flax module whose __call__ takes one input array
+    :param optimizer: optax transformation applied on every backward batch
+    :param sample_input: schema-defining input WITH batch dim (any batch size)
+    """
+
+    def __init__(
+        self,
+        name: str,
+        module,
+        *,
+        optimizer,
+        sample_input: np.ndarray,
+        max_batch_size: int = 4096,
+        rng_seed: int = 0,
+    ):
+        self.name, self.module, self.optimizer = name, module, optimizer
+        self.max_batch_size = max_batch_size
+        sample = jnp.asarray(sample_input[:1])
+        self.params = module.init(jax.random.PRNGKey(rng_seed), sample)["params"]
+        self.opt_state = optimizer.init(self.params)
+        self._state_lock = threading.Lock()
+        self.update_count = 0
+
+        sample_out = module.apply({"params": self.params}, sample)
+        self.forward_schema = (BatchTensorDescriptor.from_array(np.asarray(sample_input)),)
+        self.outputs_schema = (BatchTensorDescriptor.from_array(np.asarray(sample_out)),)
+
+        @jax.jit
+        def _forward(params, x):
+            return module.apply({"params": params}, x)
+
+        @jax.jit
+        def _backward(params, opt_state, x, grad_out):
+            import optax
+
+            out, vjp = jax.vjp(lambda p, xx: module.apply({"params": p}, xx), params, x)
+            grad_params, grad_x = vjp(grad_out)
+            updates, new_opt_state = optimizer.update(grad_params, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return grad_x, new_params, new_opt_state
+
+        self._jit_forward, self._jit_backward = _forward, _backward
+
+    # ------------------------------------------------------------------ execution
+
+    def _pad(self, batch: np.ndarray) -> Tuple[jnp.ndarray, int]:
+        n = batch.shape[0]
+        bucket = bucket_batch_size(n, self.max_batch_size)
+        if bucket != n:
+            pad_width = [(0, bucket - n)] + [(0, 0)] * (batch.ndim - 1)
+            batch = np.pad(batch, pad_width)
+        return jnp.asarray(batch), n
+
+    def forward(self, inputs: np.ndarray) -> np.ndarray:
+        """Inference on a concatenated batch (no parameter updates)."""
+        padded, n = self._pad(np.asarray(inputs, np.float32))
+        with self._state_lock:
+            params = self.params
+        out = self._jit_forward(params, padded)
+        return np.asarray(out)[:n]
+
+    def backward(self, inputs: np.ndarray, grad_outputs: np.ndarray) -> np.ndarray:
+        """Gradient wrt inputs; ALSO applies one optimizer update to the expert
+        (reference on_backward: the server trains on every backward call)."""
+        padded_x, n = self._pad(np.asarray(inputs, np.float32))
+        padded_g, _ = self._pad(np.asarray(grad_outputs, np.float32))
+        with self._state_lock:
+            grad_x, new_params, new_opt_state = self._jit_backward(
+                self.params, self.opt_state, padded_x, padded_g
+            )
+            self.params, self.opt_state = new_params, new_opt_state
+            self.update_count += 1
+        return np.asarray(grad_x)[:n]
+
+    # ------------------------------------------------------------------ metadata/state
+
+    def get_info(self) -> Dict[str, Any]:
+        return dict(
+            forward_schema=list(self.forward_schema),
+            outputs_schema=list(self.outputs_schema),
+            max_batch_size=self.max_batch_size,
+            updates=self.update_count,
+        )
+
+    def state_dict(self) -> bytes:
+        import flax.serialization
+
+        with self._state_lock:
+            return flax.serialization.to_bytes(
+                {"params": self.params, "opt_state": self.opt_state, "updates": self.update_count}
+            )
+
+    def load_state_dict(self, blob: bytes) -> None:
+        import flax.serialization
+
+        with self._state_lock:
+            template = {"params": self.params, "opt_state": self.opt_state, "updates": 0}
+            restored = flax.serialization.from_bytes(template, blob)
+            self.params = restored["params"]
+            self.opt_state = restored["opt_state"]
+            self.update_count = int(restored["updates"])

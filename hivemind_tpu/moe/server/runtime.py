@@ -1,0 +1,80 @@
+"""The device executor: drains task pools by priority and runs their jitted
+processing functions (capability parity: reference hivemind/moe/server/runtime.py:22-199
+— there a thread juggling fork pipes; here an asyncio task + executor thread so device
+dispatch never blocks the event loop)."""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence
+
+from hivemind_tpu.moe.server.task_pool import TaskPool
+from hivemind_tpu.utils.asyncio_utils import run_in_executor
+from hivemind_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class Runtime:
+    def __init__(self, pools: Sequence[TaskPool], stats_report_interval: Optional[float] = 60.0):
+        self.pools = list(pools)
+        self.stats_report_interval = stats_report_interval
+        self._task: Optional[asyncio.Task] = None
+        self._stats: Dict[str, List[float]] = defaultdict(lambda: [0.0, 0.0, 0.0])  # batches, samples, seconds
+        self._last_report = time.perf_counter()
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._run())
+
+    async def _run(self) -> None:
+        while True:
+            waiters = [asyncio.create_task(pool.wait_for_tasks()) for pool in self.pools]
+            try:
+                await asyncio.wait(waiters, return_when=asyncio.FIRST_COMPLETED)
+            finally:
+                for waiter in waiters:
+                    waiter.cancel()
+            pool = min(self.pools, key=lambda p: p.priority)
+            if pool.priority == float("inf"):
+                await asyncio.sleep(0.001)
+                continue
+            batch = pool.pop_batch()
+            if not batch:
+                continue
+            start = time.perf_counter()
+            try:
+                await run_in_executor(pool.process_batch, batch)
+            except Exception as e:
+                logger.warning(f"pool {pool.name}: batch failed with {e!r}")
+                pool.fail_batch(batch, e)
+                continue
+            elapsed = time.perf_counter() - start
+            stats = self._stats[pool.name]
+            stats[0] += 1
+            stats[1] += sum(t.batch_size for t in batch)
+            stats[2] += elapsed
+            self._maybe_report_stats()
+
+    def _maybe_report_stats(self) -> None:
+        """StatsReporter parity (reference runtime.py:161-199): periodic per-pool
+        batch size / throughput logging."""
+        if self.stats_report_interval is None:
+            return
+        now = time.perf_counter()
+        if now - self._last_report < self.stats_report_interval:
+            return
+        self._last_report = now
+        for name, (batches, samples, seconds) in sorted(self._stats.items()):
+            if batches:
+                logger.info(
+                    f"[{name}] {int(batches)} batches, avg size {samples / batches:.1f}, "
+                    f"{samples / max(seconds, 1e-9):.0f} samples/s device time"
+                )
+        self._stats.clear()
+
+    def shutdown(self) -> None:
+        if self._task is not None:
+            self._task.cancel()

@@ -1,0 +1,117 @@
+"""Batching queues between RPC handlers and the device runtime (capability parity:
+reference hivemind/moe/server/task_pool.py:59-256 — there a fork with shared-memory
+transfer; here an asyncio queue in the single-process runtime)."""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.timed_storage import get_dht_time
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class _Task:
+    args: Tuple[np.ndarray, ...]
+    future: asyncio.Future
+    timestamp: float = field(default_factory=get_dht_time)
+
+    @property
+    def batch_size(self) -> int:
+        return self.args[0].shape[0]
+
+
+class TaskPool:
+    """Collects tasks for one processing function; the Runtime drains the
+    highest-priority pool (priority = oldest undispatched task, reference
+    task_pool.py:169-176)."""
+
+    def __init__(
+        self,
+        process_func: Callable[..., Sequence[np.ndarray]],
+        name: str,
+        *,
+        max_batch_size: int = 4096,
+        min_batch_size: int = 1,
+        flush_timeout: float = 0.1,
+    ):
+        self.process_func = process_func
+        self.name = name
+        self.max_batch_size = max_batch_size
+        self.min_batch_size = min_batch_size
+        self.flush_timeout = flush_timeout  # sub-min batches run anyway after this age
+        self._queue: List[_Task] = []
+        self._task_added: Optional[asyncio.Event] = None
+
+    def _event(self) -> asyncio.Event:
+        if self._task_added is None:
+            self._task_added = asyncio.Event()
+        return self._task_added
+
+    async def submit_task(self, *args: np.ndarray) -> Sequence[np.ndarray]:
+        """Enqueue one task; resolves with its slice of the batched output."""
+        batch_size = args[0].shape[0]
+        if batch_size > self.max_batch_size:
+            raise ValueError(f"task of {batch_size} items exceeds max_batch_size={self.max_batch_size}")
+        task = _Task(tuple(np.asarray(a) for a in args), asyncio.get_event_loop().create_future())
+        self._queue.append(task)
+        self._event().set()
+        return await task.future
+
+    @property
+    def priority(self) -> float:
+        """Lower is more urgent: timestamp of the oldest queued task. A queue below
+        min_batch_size is deprioritized only until its oldest task exceeds
+        flush_timeout — never starved (the reference flushes partial batches too)."""
+        if not self._queue:
+            return float("inf")
+        total = sum(t.batch_size for t in self._queue)
+        oldest = self._queue[0].timestamp
+        if total < self.min_batch_size and get_dht_time() - oldest < self.flush_timeout:
+            return float("inf")
+        return oldest
+
+    def pop_batch(self) -> List[_Task]:
+        """Remove up to max_batch_size samples' worth of tasks."""
+        batch, total = [], 0
+        while self._queue and total + self._queue[0].batch_size <= self.max_batch_size:
+            task = self._queue.pop(0)
+            batch.append(task)
+            total += task.batch_size
+        if self._task_added is not None and not self._queue:
+            self._task_added.clear()
+        return batch
+
+    async def wait_for_tasks(self) -> None:
+        await self._event().wait()
+
+    def process_batch(self, tasks: List[_Task]) -> None:
+        """Run process_func on the concatenated batch; split outputs per task.
+        Called from the Runtime's executor thread via call_soon_threadsafe plumbing."""
+        num_args = len(tasks[0].args)
+        joined = [np.concatenate([t.args[i] for t in tasks], axis=0) for i in range(num_args)]
+        outputs = self.process_func(*joined)
+        if isinstance(outputs, np.ndarray):
+            outputs = [outputs]
+        offset = 0
+        for task in tasks:
+            size = task.batch_size
+            task_out = [np.asarray(out[offset : offset + size]) for out in outputs]
+            offset += size
+            if not task.future.done():
+                task.future.get_loop().call_soon_threadsafe(
+                    lambda t=task, o=task_out: t.future.done() or t.future.set_result(o)
+                )
+
+    def fail_batch(self, tasks: List[_Task], exc: BaseException) -> None:
+        for task in tasks:
+            if not task.future.done():
+                task.future.get_loop().call_soon_threadsafe(
+                    lambda t=task: t.future.done() or t.future.set_exception(exc)
+                )

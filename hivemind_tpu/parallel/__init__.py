@@ -1,0 +1,8 @@
+from hivemind_tpu.parallel.mesh import (
+    batch_sharding,
+    make_mesh,
+    param_spec,
+    params_shardings,
+    replicated,
+)
+from hivemind_tpu.parallel.ring_attention import plain_attention, ring_attention

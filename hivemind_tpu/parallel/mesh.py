@@ -1,0 +1,75 @@
+"""Device-mesh construction and sharding rules — the intra-slice parallelism layer
+beneath the swarm (SURVEY §2.9: TP/SP/DP come from pjit/shard_map over the ICI mesh;
+one slice acts as one logical swarm peer).
+
+Axes: ``dp`` (data), ``tp`` (tensor/model), ``sp`` (sequence/context). Collectives ride
+ICI when the mesh maps onto a physical slice; the swarm layer handles cross-pod."""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    dp: int = 1, tp: int = 1, sp: int = 1, devices: Optional[Sequence] = None
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    needed = dp * tp * sp
+    assert len(devices) >= needed, f"need {needed} devices, have {len(devices)}"
+    array = np.array(devices[:needed]).reshape(dp, tp, sp)
+    return Mesh(array, axis_names=("dp", "tp", "sp"))
+
+
+# sharding rules for transformer parameters, matched against '/'-joined param paths.
+# TP shards attention heads and the ffn intermediate dimension; everything else is
+# replicated (embeddings stay replicated: ALBERT's factorized embedding is small).
+_PARAM_RULES = [
+    (r".*(query|key|value)/kernel$", P(None, "tp")),
+    (r".*(query|key|value)/bias$", P("tp")),
+    (r".*attention_out/kernel$", P("tp", None)),
+    (r".*attention_out/bias$", P()),
+    (r".*ffn_up/kernel$", P(None, "tp")),
+    (r".*ffn_up/bias$", P("tp")),
+    (r".*ffn_down/kernel$", P("tp", None)),
+    (r".*ffn_down/bias$", P()),
+]
+
+
+def param_spec(path: str, value) -> P:
+    for pattern, spec in _PARAM_RULES:
+        if re.fullmatch(pattern, path):
+            return spec
+    return P()  # replicated
+
+
+def params_shardings(params, mesh: Mesh):
+    """NamedShardings for a flax param pytree, by path-matching the rules above."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def path_str(key_path) -> str:
+        parts = []
+        for entry in key_path:
+            name = getattr(entry, "key", None)
+            parts.append(str(name) if name is not None else str(entry))
+        return "/".join(parts)
+
+    specs = {path_str(kp): param_spec(path_str(kp), v) for kp, v in flat}
+
+    def to_sharding(key_path, value):
+        return NamedSharding(mesh, specs[path_str(key_path)])
+
+    return jax.tree_util.tree_map_with_path(to_sharding, params)
+
+
+def batch_sharding(mesh: Mesh, seq_sharded: bool = True) -> NamedSharding:
+    """Input batch [batch, seq]: batch over dp, sequence over sp (context parallel)."""
+    return NamedSharding(mesh, P("dp", "sp" if seq_sharded else None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,62 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+No reference equivalent (SURVEY §5: long-context is absent upstream; this is the
+TPU-native capability layer). Keys/values rotate around the ``sp`` mesh axis via
+``jax.lax.ppermute`` while each device keeps its local queries; softmax is merged
+online (log-sum-exp carry), so memory stays O(seq_local²) and the full sequence never
+materializes on one chip. Designed for use inside shard_map over a Mesh axis."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str) -> jax.Array:
+    """Bidirectional (encoder) ring attention. All inputs are the LOCAL sequence
+    shard: [batch, seq_local, heads, head_dim]. Must run inside shard_map with
+    ``axis_name`` mapped over the sequence-parallel mesh axis."""
+    axis_size = lax.psum(1, axis_name)
+    batch, seq_local, heads, dim = q.shape
+    # derive initial carries from q so they inherit its varying manual axes
+    # (jax >= 0.9 shard_map rejects unvarying zeros as scan carries)
+    zeros_bht = jnp.transpose(q[..., 0], (0, 2, 1)) * 0  # [B, H, T_local]
+    row_max = zeros_bht - jnp.inf
+    row_sum = zeros_bht
+    acc = q * 0
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def body(carry, _):
+        k_cur, v_cur, row_max, row_sum, acc = carry
+        scale = dim ** -0.5
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cur) * scale
+        block_max = jnp.max(scores, axis=-1)
+        new_max = jnp.maximum(row_max, block_max)
+        correction = jnp.exp(row_max - new_max)
+        probs = jnp.exp(scores - new_max[..., None])
+        acc_new = acc * correction.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", probs, v_cur
+        )
+        row_sum_new = row_sum * correction + jnp.sum(probs, axis=-1)
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (k_next, v_next, new_max, row_sum_new, acc_new), None
+
+    (k_final, v_final, row_max, row_sum, acc), _ = lax.scan(
+        body, (k, v, row_max, row_sum, acc), None, length=axis_size
+    )
+    return acc / row_sum.transpose(0, 2, 1)[..., None]
+
+
+def plain_attention(q: jax.Array, k: jax.Array, v: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
+    """Single-device attention core with the same [B, T, H, D] convention."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        scores = jnp.where(mask[:, None, None, :], scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -1,0 +1,95 @@
+"""Flagship model + parallel layer: ALBERT forward/loss, ring attention vs plain
+attention equivalence, multi-device sharded training step on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from hivemind_tpu.models import AlbertConfig, AlbertForMaskedLM, make_synthetic_mlm_batch, make_train_step, mlm_loss
+from hivemind_tpu.parallel import make_mesh, params_shardings, plain_attention, ring_attention
+
+
+def test_albert_forward_and_shapes():
+    config = AlbertConfig.tiny()
+    model = AlbertForMaskedLM(config)
+    batch = make_synthetic_mlm_batch(jax.random.PRNGKey(0), config, batch_size=2, seq_len=16)
+    params = model.init(jax.random.PRNGKey(1), batch["input_ids"])["params"]
+    logits = model.apply({"params": params}, batch["input_ids"])
+    assert logits.shape == (2, 16, config.vocab_size)
+    assert logits.dtype == jnp.float32
+    loss = mlm_loss(logits, batch["labels"], batch["mlm_mask"])
+    assert np.isfinite(float(loss)) and float(loss) > 0
+    # parameter sharing: one layer's worth of encoder params regardless of depth
+    deep = AlbertForMaskedLM(AlbertConfig.tiny(num_layers=6))
+    deep_params = deep.init(jax.random.PRNGKey(1), batch["input_ids"])["params"]
+    count = lambda p: sum(x.size for x in jax.tree_util.tree_leaves(p))
+    assert count(deep_params) == count(params)
+
+
+def test_albert_training_reduces_loss():
+    config = AlbertConfig.tiny()
+    optimizer = optax.adam(1e-3)
+    model, train_step = make_train_step(config, optimizer)
+    batch = make_synthetic_mlm_batch(jax.random.PRNGKey(0), config, batch_size=4, seq_len=32)
+    params = model.init(jax.random.PRNGKey(1), batch["input_ids"])["params"]
+    opt_state = optimizer.init(params)
+    step = jax.jit(train_step)
+    first_loss = None
+    for _ in range(30):
+        loss, params, opt_state = step(params, opt_state, batch)
+        first_loss = first_loss if first_loss is not None else float(loss)
+    assert float(loss) < first_loss * 0.7, f"loss {first_loss} -> {float(loss)}"
+
+
+def test_ring_attention_matches_plain():
+    """Ring attention over the sp axis must reproduce single-device attention."""
+    mesh = make_mesh(dp=1, tp=1, sp=4)
+    batch, seq, heads, dim = 2, 32, 4, 8
+    rng = jax.random.PRNGKey(0)
+    q, k, v = (
+        jax.random.normal(key, (batch, seq, heads, dim), jnp.float32)
+        for key in jax.random.split(rng, 3)
+    )
+    expected = plain_attention(q, k, v)
+
+    from functools import partial
+    from jax import shard_map
+
+    spec = P(None, "sp", None, None)
+    ring = shard_map(
+        partial(ring_attention, axis_name="sp"),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    with mesh:
+        result = jax.jit(ring)(q, k, v)
+    assert np.allclose(np.asarray(result), np.asarray(expected), atol=1e-4)
+
+
+def test_sharded_training_step_8_devices():
+    """Full dp×tp×sp sharded train step on the virtual 8-device mesh — the same path
+    the driver's dryrun_multichip exercises."""
+    mesh = make_mesh(dp=2, tp=2, sp=2)
+    config = AlbertConfig.tiny(mesh=mesh)
+    optimizer = optax.sgd(1e-2)
+    model, train_step = make_train_step(config, optimizer)
+    batch = make_synthetic_mlm_batch(jax.random.PRNGKey(0), config, batch_size=4, seq_len=32)
+    params = model.init(jax.random.PRNGKey(1), batch["input_ids"])["params"]
+    opt_state = optimizer.init(params)
+
+    shardings = params_shardings(params, mesh)
+    params = jax.device_put(params, shardings)
+    batch_sharded = jax.device_put(
+        batch, NamedSharding(mesh, P("dp", "sp"))
+    )
+    with mesh:
+        step = jax.jit(train_step)
+        loss, new_params, new_opt_state = step(params, opt_state, batch_sharded)
+        loss2, _, _ = step(new_params, new_opt_state, batch_sharded)
+    assert np.isfinite(float(loss)) and np.isfinite(float(loss2))
+    assert float(loss2) < float(loss)  # sgd on the same batch must descend
+    # tp sharding actually applied to attention kernels
+    q_kernel = new_params["shared_layer"]["query"]["kernel"]
+    assert "tp" in str(q_kernel.sharding.spec)
