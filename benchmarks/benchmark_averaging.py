@@ -1,0 +1,80 @@
+"""Butterfly all-reduce benchmark (parity: reference benchmarks/benchmark_averaging.py
+— 16 peers, groups of 4, ~8.6M params). Reports rounds, success rate, and the driver
+north-star: effective GB/s per peer."""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num_peers", type=int, default=8)
+    parser.add_argument("--target_group_size", type=int, default=4)
+    parser.add_argument("--num_rounds", type=int, default=3)
+    parser.add_argument("--num_params", type=int, default=1_000_000)
+    parser.add_argument("--compression", default="FLOAT16")
+    args = parser.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.devices()
+
+    from hivemind_tpu.averaging import DecentralizedAverager
+    from hivemind_tpu.compression import CompressionType, get_codec
+    from hivemind_tpu.dht import DHT
+
+    first = DHT(start=True)
+    maddrs = [str(m) for m in first.get_visible_maddrs()]
+    dhts = [first] + [DHT(initial_peers=maddrs, start=True) for _ in range(args.num_peers - 1)]
+    codec = get_codec(getattr(CompressionType, args.compression))
+    averagers = []
+    for i, dht in enumerate(dhts):
+        rng = np.random.RandomState(i)
+        tensors = [rng.randn(args.num_params).astype(np.float32)]
+        averagers.append(
+            DecentralizedAverager(
+                tensors, dht, prefix="bench", start=True,
+                target_group_size=args.target_group_size,
+                min_matchmaking_time=2.0, compression=codec,
+                initial_group_bits="" if args.num_peers <= args.target_group_size else "0",
+            )
+        )
+
+    successes = attempts = 0
+    start = time.perf_counter()
+    for round_index in range(args.num_rounds):
+        controls = [a.step(wait=False, timeout=60) for a in averagers]
+        for control in controls:
+            attempts += 1
+            try:
+                control.result(timeout=90)
+                successes += 1
+            except Exception:
+                pass
+    elapsed = time.perf_counter() - start
+
+    bytes_per_peer_round = args.num_params * 4 * 2  # send + receive one vector's worth
+    gbps_per_peer = bytes_per_peer_round * args.num_rounds / elapsed / 1e9
+    print(json.dumps({
+        "metric": "averaging_gbps_per_peer",
+        "value": round(gbps_per_peer, 4),
+        "unit": "GB/s/peer",
+        "extra": {
+            "peers": args.num_peers, "rounds": args.num_rounds,
+            "params": args.num_params, "success_rate": successes / max(attempts, 1),
+            "seconds_per_round": round(elapsed / args.num_rounds, 3),
+        },
+    }))
+    for averager in averagers:
+        averager.shutdown()
+    for dht in dhts:
+        dht.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""DHT store/get benchmark (parity: reference benchmarks/benchmark_dht.py — baselines
+store 14.9ms/key, get 6.6ms/key at 1024 peers)."""
+
+import argparse
+import json
+import time
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num_peers", type=int, default=16)
+    parser.add_argument("--num_keys", type=int, default=200)
+    parser.add_argument("--expiration", type=float, default=300.0)
+    args = parser.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.devices()
+
+    from hivemind_tpu.dht import DHT
+    from hivemind_tpu.utils.timed_storage import get_dht_time
+
+    first = DHT(start=True)
+    maddrs = [str(m) for m in first.get_visible_maddrs()]
+    dhts = [first] + [DHT(initial_peers=maddrs, start=True) for _ in range(args.num_peers - 1)]
+
+    store_ok = get_ok = 0
+    start = time.perf_counter()
+    for i in range(args.num_keys):
+        writer = dhts[i % len(dhts)]
+        store_ok += bool(writer.store(f"bench_key_{i}", i, get_dht_time() + args.expiration))
+    store_time = time.perf_counter() - start
+
+    start = time.perf_counter()
+    for i in range(args.num_keys):
+        reader = dhts[(i + 7) % len(dhts)]
+        result = reader.get(f"bench_key_{i}")
+        get_ok += result is not None and result.value == i
+    get_time = time.perf_counter() - start
+
+    print(json.dumps({
+        "metric": "dht_store_get_latency",
+        "value": round(store_time / args.num_keys * 1000, 3),
+        "unit": "ms/store",
+        "extra": {
+            "peers": args.num_peers, "keys": args.num_keys,
+            "store_ms": round(store_time / args.num_keys * 1000, 3),
+            "get_ms": round(get_time / args.num_keys * 1000, 3),
+            "store_success": store_ok / args.num_keys,
+            "get_success": get_ok / args.num_keys,
+        },
+    }))
+    for dht in dhts:
+        dht.shutdown()
+
+
+if __name__ == "__main__":
+    main()

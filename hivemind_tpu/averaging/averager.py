@@ -197,8 +197,11 @@ class DecentralizedAverager(ServicerBase):
             with contextlib.suppress(Exception):
                 await self.remove_p2p_handlers(self.p2p, namespace=self.prefix)
 
-        with contextlib.suppress(Exception):
-            self._runner.run_coroutine(_teardown(), return_future=True).result(self.shutdown_timeout)
+        coro = _teardown()
+        try:
+            self._runner.run_coroutine(coro, return_future=True).result(self.shutdown_timeout)
+        except Exception:
+            coro.close()  # loop already gone: release the un-awaited coroutine cleanly
 
     def __enter__(self):
         if not self._ready.is_set():
@@ -315,15 +318,19 @@ class DecentralizedAverager(ServicerBase):
         except Exception as e:
             control.set_exception(e)
 
-    async def _aggregate_with_group(self, group_info: GroupInfo, weight: float) -> GatheredData:
-        """Decode gathered metadata, balance load, run the all-reduce, apply deltas
-        (reference averager.py:514-562)."""
+    def _decode_gathered(self, group_info: GroupInfo):
         bandwidths, modes, user_gathered = [], [], {}
         for peer_id, blob in zip(group_info.peer_ids, group_info.gathered):
             peer_bandwidth, peer_mode, user_data = MSGPackSerializer.loads(blob)
             bandwidths.append(float(peer_bandwidth))
             modes.append(AveragingMode(peer_mode))
             user_gathered[peer_id] = user_data
+        return bandwidths, modes, user_gathered
+
+    async def _aggregate_with_group(self, group_info: GroupInfo, weight: float) -> GatheredData:
+        """Decode gathered metadata, balance load, run the all-reduce, apply deltas
+        (reference averager.py:514-562)."""
+        bandwidths, modes, user_gathered = self._decode_gathered(group_info)
 
         with self.lock_averaged_tensors:
             total_elements = sum(int(np.prod(t.shape)) for t in self._averaged_tensors)
@@ -355,6 +362,54 @@ class DecentralizedAverager(ServicerBase):
             return user_gathered
         finally:
             self._running_allreduces.pop(group_info.group_id, None)
+
+    async def _run_manual_allreduce(
+        self,
+        group_info: GroupInfo,
+        tensors: List[np.ndarray],
+        *,
+        group_id_suffix: bytes,
+        modes: Sequence[AveragingMode],
+        bandwidths: Sequence[float],
+        weight: float,
+    ) -> List[np.ndarray]:
+        """One all-reduce over arbitrary tensors within an already-matched group —
+        the building block for multi-phase schemes like PowerSGD (which chains two
+        rounds per group, reference power_sgd_averager.py:117-178). Returns the
+        averaged tensors (inputs are not mutated)."""
+        group_id = group_info.group_id + group_id_suffix
+        total_elements = sum(int(np.prod(t.shape)) for t in tensors)
+        reducer_bandwidths = [
+            bandwidth if mode != AveragingMode.CLIENT else 0.0
+            for bandwidth, mode in zip(bandwidths, modes)
+        ]
+        peer_element_counts = load_balance_peers(total_elements, reducer_bandwidths)
+        runner = AllReduceRunner(
+            p2p=self.p2p,
+            group_id=group_id,
+            tensors=tensors,
+            ordered_peer_ids=group_info.peer_ids,
+            peer_element_counts=peer_element_counts,
+            modes=modes,
+            get_stub=self._get_peer_stub,
+            weight=weight,
+            compression=self.compression,
+            part_size_bytes=self.part_size_bytes,
+            sender_timeout=self.sender_timeout,
+            reducer_timeout=self.reducer_timeout,
+        )
+        async with self._allreduce_registered:
+            self._running_allreduces[group_id] = runner
+            self._allreduce_registered.notify_all()
+        try:
+            averaged = [np.array(t, dtype=np.float32, copy=True) for t in tensors]
+            index = 0
+            async for delta in runner.run():
+                averaged[index] += delta.reshape(averaged[index].shape)
+                index += 1
+            return averaged
+        finally:
+            self._running_allreduces.pop(group_id, None)
 
     def _make_allreduce_runner(
         self,

@@ -1,0 +1,56 @@
+"""Run a MoE expert server (capability parity: reference
+hivemind/hivemind_cli/run_server.py)."""
+
+from __future__ import annotations
+
+import argparse
+import time
+from pathlib import Path
+
+from hivemind_tpu.moe import Server
+from hivemind_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Run a hivemind_tpu MoE expert server")
+    parser.add_argument("--num_experts", type=int, default=None)
+    parser.add_argument("--expert_uids", nargs="*", default=None, help="explicit expert uids")
+    parser.add_argument("--expert_pattern", default=None, help="e.g. 'ffn.[0:16].[0:16]'")
+    parser.add_argument("--expert_cls", default="ffn", help="registered expert class")
+    parser.add_argument("--hidden_dim", type=int, default=1024)
+    parser.add_argument("--max_batch_size", type=int, default=4096)
+    parser.add_argument("--initial_peers", nargs="*", default=[])
+    parser.add_argument("--checkpoint_dir", default=None)
+    parser.add_argument("--learning_rate", type=float, default=1e-3)
+    args = parser.parse_args()
+
+    import optax
+
+    server = Server.create(
+        num_experts=args.num_experts,
+        expert_uids=args.expert_uids,
+        expert_pattern=args.expert_pattern,
+        expert_cls=args.expert_cls,
+        hidden_dim=args.hidden_dim,
+        max_batch_size=args.max_batch_size,
+        initial_peers=args.initial_peers,
+        checkpoint_dir=Path(args.checkpoint_dir) if args.checkpoint_dir else None,
+        optim_factory=lambda: optax.adam(args.learning_rate),
+        start=True,
+    )
+    for maddr in server.dht.get_visible_maddrs():
+        logger.info(f"listening: {maddr}")
+    logger.info(f"serving {len(server.backends)} experts: {sorted(server.backends)[:8]}…")
+    try:
+        while True:
+            time.sleep(60)
+    except KeyboardInterrupt:
+        logger.info("shutting down")
+        server.shutdown()
+        server.dht.shutdown()
+
+
+if __name__ == "__main__":
+    main()

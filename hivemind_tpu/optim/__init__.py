@@ -1,8 +1,11 @@
 from hivemind_tpu.optim.grad_averager import GradientAverager
+from hivemind_tpu.optim.grad_scaler import GradScaler
 from hivemind_tpu.optim.optimizer import Optimizer
+from hivemind_tpu.optim.power_sgd_averager import PowerSGDGradientAverager
 from hivemind_tpu.optim.progress_tracker import (
     GlobalTrainingProgress,
     LocalTrainingProgress,
     ProgressTracker,
 )
 from hivemind_tpu.optim.state_averager import TrainingStateAverager
+from hivemind_tpu.optim.training_averager import TrainingAverager

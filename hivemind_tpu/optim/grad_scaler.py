@@ -1,0 +1,64 @@
+"""GradScaler analog (capability parity: reference hivemind/optim/grad_scaler.py:25-127).
+
+DELIBERATE DEVIATION: the reference exists because fp16 CUDA training needs dynamic
+loss scaling synchronized with global (epoch) steps. TPU training runs bf16, whose
+exponent range matches fp32 — no loss scaling is needed — so this class is an
+API-compatible passthrough that only tracks overflow statistics (useful when users
+port fp16 recipes). It keeps the hivemind-specific contract: unscale/update are
+deferred to global optimizer steps."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+from hivemind_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class GradScaler:
+    def __init__(self, init_scale: float = 1.0, enabled: bool = True):
+        if init_scale != 1.0:
+            logger.warning(
+                "bf16 TPU training needs no loss scaling; GradScaler runs with scale=1 "
+                "(fp16-style dynamic scaling is a no-op here by design)"
+            )
+        self._scale = 1.0
+        self._enabled = enabled
+        self._found_inf = False
+        self._lock = threading.RLock()
+        self._inner_step_allowed = False
+
+    def scale(self, value):
+        return value  # scale is always 1 on TPU/bf16
+
+    def unscale_(self, grads) -> bool:
+        """Record non-finite gradients (returns True if grads are clean)."""
+        with self._lock:
+            import jax
+
+            leaves = jax.tree_util.tree_leaves(grads)
+            self._found_inf = any(not bool(np.isfinite(np.asarray(l)).all()) for l in leaves)
+            return not self._found_inf
+
+    def step(self, apply_fn, *args, **kwargs):
+        """Run the optimizer update unless the last unscale_ found inf/nan."""
+        with self._lock:
+            if self._found_inf:
+                logger.warning("skipping optimizer step: non-finite gradients")
+                return None
+            return apply_fn(*args, **kwargs)
+
+    def update(self) -> None:
+        with self._lock:
+            self._found_inf = False
+
+    def get_scale(self) -> float:
+        return self._scale
+
+    @property
+    def found_inf(self) -> bool:
+        return self._found_inf

@@ -1,0 +1,139 @@
+"""Authorization framework (capability parity: reference hivemind/utils/auth.py:33-212).
+
+``TokenAuthorizerBase`` issues signed access tokens; ``AuthRPCWrapper`` wraps a
+servicer so every rpc_* call is validated (SERVICER role) or stamped (CLIENT role).
+Tokens are Ed25519-signed blobs with expiry, the caller's public key, and a nonce;
+replay is rejected within a clock window (reference: ±1 min window, nonce cache)."""
+
+from __future__ import annotations
+
+import os
+import threading
+from abc import ABC, abstractmethod
+from typing import Any, Optional
+
+from hivemind_tpu.utils.crypto import Ed25519PrivateKey, Ed25519PublicKey
+from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.serializer import MSGPackSerializer
+from hivemind_tpu.utils.timed_storage import TimedStorage, get_dht_time
+
+logger = get_logger(__name__)
+
+MAX_CLIENT_SERVICER_TIME_DIFF = 60.0  # seconds (reference: ±1 minute clock window)
+
+
+class AuthorizationError(RuntimeError):
+    pass
+
+
+class AuthorizerBase(ABC):
+    @abstractmethod
+    def issue_token(self) -> bytes: ...
+
+    @abstractmethod
+    def validate_token(self, token: bytes) -> bool: ...
+
+
+class TokenAuthorizerBase(AuthorizerBase):
+    """Self-issued signed tokens: [client_pubkey, expiry, nonce] signed by the trust
+    authority's key. Subclasses may fetch tokens from an external auth server instead
+    (the reference's design intent)."""
+
+    def __init__(
+        self,
+        authority_key: Optional[Ed25519PrivateKey] = None,
+        local_key: Optional[Ed25519PrivateKey] = None,
+        token_lifetime: float = 600.0,
+    ):
+        self.authority_key = authority_key
+        self.authority_public = (
+            authority_key.get_public_key() if authority_key is not None else None
+        )
+        self.local_key = local_key if local_key is not None else Ed25519PrivateKey.process_wide()
+        self.token_lifetime = token_lifetime
+        self._seen_nonces: TimedStorage[bytes, bool] = TimedStorage(maxsize=100_000)
+        self._lock = threading.Lock()
+
+    def set_authority_public_key(self, public_key: Ed25519PublicKey) -> None:
+        self.authority_public = public_key
+
+    def issue_token(self) -> bytes:
+        assert self.authority_key is not None, "only the authority can issue tokens"
+        payload = MSGPackSerializer.dumps(
+            [
+                self.local_key.get_public_key().to_bytes(),
+                get_dht_time() + self.token_lifetime,
+                os.urandom(16),
+            ]
+        )
+        return MSGPackSerializer.dumps([payload, self.authority_key.sign(payload)])
+
+    def validate_token(self, token: bytes) -> bool:
+        if self.authority_public is None:
+            logger.warning("no authority public key configured; rejecting token")
+            return False
+        try:
+            payload, signature = MSGPackSerializer.loads(token)
+            if not self.authority_public.verify(payload, signature):
+                return False
+            _client_pubkey, expiry, nonce = MSGPackSerializer.loads(payload)
+        except Exception:
+            return False
+        now = get_dht_time()
+        if expiry < now - MAX_CLIENT_SERVICER_TIME_DIFF:
+            return False
+        with self._lock:
+            if nonce in self._seen_nonces:
+                logger.debug("replayed auth token rejected")
+                return False
+            self._seen_nonces.store(nonce, True, expiry + MAX_CLIENT_SERVICER_TIME_DIFF)
+        return True
+
+
+class AuthRole:
+    CLIENT = "client"
+    SERVICER = "servicer"
+
+
+class AuthRPCWrapper:
+    """Wraps a servicer's rpc_* methods (reference AuthRPCWrapper): in SERVICER role,
+    requests whose ``peer.auth_token`` fails validation are rejected; in CLIENT role,
+    outgoing requests get a fresh token stamped into ``peer.auth_token``."""
+
+    def __init__(self, stub_or_servicer: Any, role: str, authorizer: AuthorizerBase):
+        self._wrapped = stub_or_servicer
+        self._role = role
+        self._authorizer = authorizer
+
+    def __getattr__(self, name: str):
+        import inspect
+
+        attr = getattr(self._wrapped, name)
+        if not name.startswith("rpc_") or not callable(attr):
+            return attr
+        role, authorizer = self._role, self._authorizer
+
+        def _check_or_stamp(request) -> None:
+            if role == AuthRole.SERVICER:
+                token = getattr(getattr(request, "peer", None), "auth_token", b"")
+                if not authorizer.validate_token(token):
+                    raise AuthorizationError(f"{name}: missing or invalid access token")
+            elif role == AuthRole.CLIENT:
+                peer = getattr(request, "peer", None)
+                if peer is not None:
+                    peer.auth_token = authorizer.issue_token()
+
+        if inspect.isasyncgenfunction(attr):
+
+            async def stream_wrapped(request, *args, **kwargs):
+                _check_or_stamp(request)
+                async for item in attr(request, *args, **kwargs):
+                    yield item
+
+            return stream_wrapped
+
+        async def wrapped(request, *args, **kwargs):
+            _check_or_stamp(request)
+            return await attr(request, *args, **kwargs)
+
+        return wrapped

@@ -1,0 +1,128 @@
+"""PowerSGD averaging (two chained phases, error feedback), GradScaler shim,
+TrainingAverager legacy, math utils."""
+
+import numpy as np
+import optax
+import pytest
+
+import jax.numpy as jnp
+
+from hivemind_tpu.dht import DHT
+from hivemind_tpu.optim import GradScaler, PowerSGDGradientAverager, TrainingAverager
+from hivemind_tpu.utils.math_utils import get_flatten_greedy_dims, orthogonalize
+
+
+def launch_dht_swarm(n):
+    first = DHT(start=True)
+    maddrs = [str(m) for m in first.get_visible_maddrs()]
+    return [first] + [DHT(initial_peers=maddrs, start=True) for _ in range(n - 1)]
+
+
+def test_math_utils():
+    m = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+    orthogonalize(m)
+    gram = m.T @ m
+    assert np.allclose(gram, np.eye(4), atol=1e-4)
+    assert get_flatten_greedy_dims((128, 64)) == (128, 64)
+    assert get_flatten_greedy_dims((4, 4, 16)) == (16, 16)
+    assert get_flatten_greedy_dims((100,)) == (100, 1)
+
+
+def test_powersgd_two_peer_average():
+    dhts = launch_dht_swarm(2)
+    try:
+        shapes = [(64, 32), (8,)]  # one compressible matrix + one raw vector
+        averagers = []
+        grads = {}
+        for i, dht in enumerate(dhts):
+            rng = np.random.RandomState(i)
+            # low-rank "gradients" (rank 2): a rank-4 factorization should capture them
+            low_rank = (rng.randn(64, 2) @ rng.randn(2, 32)).astype(np.float32)
+            grads[i] = [low_rank, rng.randn(8).astype(np.float32)]
+            averagers.append(
+                PowerSGDGradientAverager(
+                    [np.zeros(s, np.float32) for s in shapes],
+                    averager_rank=4,
+                    dht=dht, prefix="psgd", start=True,
+                    target_group_size=2, min_matchmaking_time=1.0, request_timeout=1.0,
+                )
+            )
+        assert averagers[0]._compressed_idx == [0] and averagers[0]._uncompressed_idx == [1]
+        for i, averager in enumerate(averagers):
+            averager.accumulate_grads_(grads[i], batch_size=1)
+        controls = [a.step(wait=False, timeout=40) for a in averagers]
+        for control in controls:
+            control.result(timeout=60)
+
+        expected_raw = (grads[0][1] + grads[1][1]) / 2
+        expected_matrix = (grads[0][0] + grads[1][0]) / 2
+        for averager in averagers:
+            with averager.use_averaged_gradients() as out:
+                # raw tensors are averaged exactly
+                assert np.allclose(out[1], expected_raw, atol=1e-4)
+                # rank-8 of a 32x16 matrix: good but approximate; direction must match
+                cos = np.sum(out[0] * expected_matrix) / (
+                    np.linalg.norm(out[0]) * np.linalg.norm(expected_matrix) + 1e-9
+                )
+                assert cos > 0.95, f"cosine similarity {cos}"
+                # error feedback holds the dropped residual
+                assert np.linalg.norm(averager._error_feedback[0]) > 0
+        for averager in averagers:
+            averager.shutdown()
+    finally:
+        for dht in dhts:
+            dht.shutdown()
+
+
+def test_grad_scaler_shim():
+    scaler = GradScaler()
+    grads = {"w": jnp.ones(4)}
+    assert scaler.unscale_(grads)
+    called = []
+    scaler.step(lambda: called.append(1))
+    assert called == [1]
+    bad = {"w": jnp.asarray([1.0, np.inf, 0, 0])}
+    assert not scaler.unscale_(bad)
+    scaler.step(lambda: called.append(2))  # skipped
+    assert called == [1]
+    scaler.update()
+    assert not scaler.found_inf
+
+
+def test_training_averager_legacy():
+    dhts = launch_dht_swarm(2)
+    try:
+        states = [
+            {"params": [np.full(10, float(i + 1), np.float32)]} for i in range(2)
+        ]
+        averagers = []
+        for i, dht in enumerate(dhts):
+            def getter(i=i):
+                return states[i]["params"]
+
+            def setter(tensors, i=i):
+                states[i]["params"] = tensors
+
+            averagers.append(
+                TrainingAverager(
+                    dht=dht, get_tensors_fn=getter, set_tensors_fn=setter,
+                    prefix="legacy", start=True, target_group_size=2,
+                    min_matchmaking_time=1.0,
+                )
+            )
+        import threading
+
+        threads = [
+            threading.Thread(target=lambda a=a: a.average_step(timeout=40)) for a in averagers
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        for i in range(2):
+            assert np.allclose(states[i]["params"][0], 1.5, atol=1e-4)
+        for a in averagers:
+            a.shutdown()
+    finally:
+        for dht in dhts:
+            dht.shutdown()
