@@ -199,9 +199,12 @@ class DecentralizedAverager(ServicerBase):
 
         coro = _teardown()
         try:
-            self._runner.run_coroutine(coro, return_future=True).result(self.shutdown_timeout)
+            future = self._runner.run_coroutine(coro, return_future=True)
         except Exception:
-            coro.close()  # loop already gone: release the un-awaited coroutine cleanly
+            coro.close()  # never scheduled: release the un-awaited coroutine cleanly
+        else:
+            with contextlib.suppress(Exception):
+                future.result(self.shutdown_timeout)
 
     def __enter__(self):
         if not self._ready.is_set():

@@ -26,11 +26,9 @@ class GradScaler:
                 "bf16 TPU training needs no loss scaling; GradScaler runs with scale=1 "
                 "(fp16-style dynamic scaling is a no-op here by design)"
             )
-        self._scale = 1.0
         self._enabled = enabled
         self._found_inf = False
         self._lock = threading.RLock()
-        self._inner_step_allowed = False
 
     def scale(self, value):
         return value  # scale is always 1 on TPU/bf16
@@ -57,7 +55,7 @@ class GradScaler:
             self._found_inf = False
 
     def get_scale(self) -> float:
-        return self._scale
+        return 1.0  # bf16: scaling is always identity
 
     @property
     def found_inf(self) -> bool:

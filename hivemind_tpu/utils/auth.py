@@ -35,9 +35,14 @@ class AuthorizerBase(ABC):
 
 
 class TokenAuthorizerBase(AuthorizerBase):
-    """Self-issued signed tokens: [client_pubkey, expiry, nonce] signed by the trust
-    authority's key. Subclasses may fetch tokens from an external auth server instead
-    (the reference's design intent)."""
+    """Signed access tokens bound to a client identity.
+
+    Roles: the AUTHORITY (holds the signing key) grants a token for a specific
+    client's transport public key via ``issue_token_for``; a CLIENT holds its granted
+    token (``set_access_token``) and stamps outgoing requests; a SERVICER validates
+    tokens AND that the authenticated sender matches the identity the token was
+    granted to — an intercepted token is useless from any other peer. Subclasses may
+    fetch tokens from an external auth service (the reference's design intent)."""
 
     def __init__(
         self,
@@ -51,24 +56,41 @@ class TokenAuthorizerBase(AuthorizerBase):
         )
         self.local_key = local_key if local_key is not None else Ed25519PrivateKey.process_wide()
         self.token_lifetime = token_lifetime
+        self.access_token: Optional[bytes] = None
         self._seen_nonces: TimedStorage[bytes, bool] = TimedStorage(maxsize=100_000)
         self._lock = threading.Lock()
 
     def set_authority_public_key(self, public_key: Ed25519PublicKey) -> None:
         self.authority_public = public_key
 
-    def issue_token(self) -> bytes:
+    def set_access_token(self, token: bytes) -> None:
+        """Install a token granted by the authority (delivered out-of-band)."""
+        self.access_token = token
+
+    def issue_token_for(self, client_public_key: Ed25519PublicKey) -> bytes:
+        """Authority-side: grant a token bound to one client's transport identity."""
         assert self.authority_key is not None, "only the authority can issue tokens"
         payload = MSGPackSerializer.dumps(
-            [
-                self.local_key.get_public_key().to_bytes(),
-                get_dht_time() + self.token_lifetime,
-                os.urandom(16),
-            ]
+            [client_public_key.to_bytes(), get_dht_time() + self.token_lifetime, os.urandom(16)]
         )
         return MSGPackSerializer.dumps([payload, self.authority_key.sign(payload)])
 
-    def validate_token(self, token: bytes) -> bool:
+    def issue_token(self) -> bytes:
+        """Authority issuing for itself (e.g. the authority is also a peer)."""
+        return self.issue_token_for(self.local_key.get_public_key())
+
+    def get_local_token(self) -> bytes:
+        """The token this peer stamps on requests: the granted one, or self-issued if
+        this peer IS the authority."""
+        if self.access_token is not None:
+            return self.access_token
+        if self.authority_key is not None:
+            return self.issue_token()
+        raise AuthorizationError("no access token: call set_access_token() with a granted token")
+
+    def validate_token(self, token: bytes, sender_peer_id: Optional[Any] = None) -> bool:
+        """Check signature, expiry, replay — and, when ``sender_peer_id`` is given,
+        that the token was granted to that transport identity."""
         if self.authority_public is None:
             logger.warning("no authority public key configured; rejecting token")
             return False
@@ -76,12 +98,25 @@ class TokenAuthorizerBase(AuthorizerBase):
             payload, signature = MSGPackSerializer.loads(token)
             if not self.authority_public.verify(payload, signature):
                 return False
-            _client_pubkey, expiry, nonce = MSGPackSerializer.loads(payload)
+            client_pubkey_bytes, expiry, nonce = MSGPackSerializer.loads(payload)
         except Exception:
             return False
         now = get_dht_time()
         if expiry < now - MAX_CLIENT_SERVICER_TIME_DIFF:
             return False
+        if sender_peer_id is not None:
+            from hivemind_tpu.p2p.peer_id import PeerID
+
+            try:
+                granted_to = PeerID.from_public_key(Ed25519PublicKey.from_bytes(client_pubkey_bytes))
+            except Exception:
+                return False
+            if granted_to != sender_peer_id:
+                logger.debug("token granted to a different peer identity; rejected")
+                return False
+            # identity binding IS the anti-replay mechanism here: the transport
+            # authenticated the sender, so the same token may be reused by its owner
+            return True
         with self._lock:
             if nonce in self._seen_nonces:
                 logger.debug("replayed auth token rejected")
@@ -113,27 +148,46 @@ class AuthRPCWrapper:
             return attr
         role, authorizer = self._role, self._authorizer
 
-        def _check_or_stamp(request) -> None:
+        def _check_or_stamp(message, context) -> None:
+            sender = getattr(context, "remote_id", None)
             if role == AuthRole.SERVICER:
-                token = getattr(getattr(request, "peer", None), "auth_token", b"")
-                if not authorizer.validate_token(token):
+                token = getattr(getattr(message, "peer", None), "auth_token", b"")
+                if not authorizer.validate_token(token, sender_peer_id=sender):
                     raise AuthorizationError(f"{name}: missing or invalid access token")
             elif role == AuthRole.CLIENT:
-                peer = getattr(request, "peer", None)
+                peer = getattr(message, "peer", None)
                 if peer is not None:
-                    peer.auth_token = authorizer.issue_token()
+                    peer.auth_token = authorizer.get_local_token()
+
+        def _prepare(request, args):
+            """Stream-input RPCs pass an iterator as the first argument: check/stamp
+            the FIRST message lazily instead of the iterator object itself."""
+            context = args[0] if args else None
+            if hasattr(request, "__aiter__"):
+
+                async def checked():
+                    first = True
+                    async for message in request:
+                        if first:
+                            _check_or_stamp(message, context)
+                            first = False
+                        yield message
+
+                return checked()
+            _check_or_stamp(request, context)
+            return request
 
         if inspect.isasyncgenfunction(attr):
 
             async def stream_wrapped(request, *args, **kwargs):
-                _check_or_stamp(request)
+                request = _prepare(request, args)
                 async for item in attr(request, *args, **kwargs):
                     yield item
 
             return stream_wrapped
 
         async def wrapped(request, *args, **kwargs):
-            _check_or_stamp(request)
+            request = _prepare(request, args)
             return await attr(request, *args, **kwargs)
 
         return wrapped

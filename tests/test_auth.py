@@ -38,6 +38,24 @@ def test_token_forgery_and_expiry():
     assert not validator.validate_token(expired_issuer.issue_token())
 
 
+def test_token_identity_binding():
+    from hivemind_tpu.p2p.peer_id import PeerID
+
+    authority = Ed25519PrivateKey()
+    issuer = TokenAuthorizerBase(authority_key=authority)
+    validator = TokenAuthorizerBase(local_key=Ed25519PrivateKey())
+    validator.set_authority_public_key(authority.get_public_key())
+
+    client_key = Ed25519PrivateKey()
+    client_id = PeerID.from_private_key(client_key)
+    other_id = PeerID.from_private_key(Ed25519PrivateKey())
+    token = issuer.issue_token_for(client_key.get_public_key())
+    # owner may reuse its bound token; any other identity is rejected
+    assert validator.validate_token(token, sender_peer_id=client_id)
+    assert validator.validate_token(token, sender_peer_id=client_id)
+    assert not validator.validate_token(token, sender_peer_id=other_id)
+
+
 async def test_auth_rpc_wrapper():
     from hivemind_tpu.proto import dht_pb2
 
