@@ -1,0 +1,275 @@
+// hivemind_tpu relay daemon — the native transport component.
+//
+// Role parity: the circuit-relay v2 capability of the reference's go-libp2p daemon
+// (hivemind/p2p/p2p_daemon.py:114-137 enables relay + auto-relay): peers behind NAT
+// register here over an OUTBOUND connection and become dialable through the relay.
+// Security model: the relay splices raw bytes; peers run their end-to-end Noise
+// handshake THROUGH it, so the relay only ever sees AEAD ciphertext.
+//
+// Control protocol (length-prefixed frames: u32 big-endian length + payload):
+//   REGISTER  'R' <peer_id bytes>        -> 'O'   (this conn becomes the control line)
+//   DIAL      'D' <16B token> <target_id>-> 'O' then splice  (sent on a FRESH conn)
+//   ACCEPT    'A' <16B token>            -> 'O' then splice  (fresh conn from target)
+//   INCOMING  'I' <16B token>            relay -> target's control line
+// After 'O' on a DIAL/ACCEPT pair the two sockets are spliced byte-for-byte.
+//
+// Build: g++ -O2 -std=c++17 -o relay_daemon relay_daemon.cpp   (see Makefile)
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <map>
+#include <string>
+#include <vector>
+
+static constexpr size_t MAX_FRAME = 1 << 20;       // control frames only
+static constexpr size_t SPLICE_BUF = 1 << 16;      // per-direction pipe buffer
+static constexpr int PENDING_DIAL_TTL_MS = 30000;  // unmatched dials expire
+
+static double now_ms() {
+  using namespace std::chrono;
+  return duration_cast<duration<double, std::milli>>(steady_clock::now().time_since_epoch()).count();
+}
+
+enum class ConnState { ReadingFrame, Control, SplicedWaiting, Spliced, Closed };
+
+struct Conn {
+  int fd = -1;
+  ConnState state = ConnState::ReadingFrame;
+  std::string inbuf;        // frame assembly
+  std::string outbuf;       // pending writes
+  std::string peer_id;      // set for control lines
+  std::string token;        // set for pending dial/accept conns
+  int peer_fd = -1;         // spliced counterpart
+  double created_ms = 0;
+  bool want_write = false;
+};
+
+static int g_epoll = -1;
+static std::map<int, Conn*> g_conns;
+static std::map<std::string, int> g_control;        // peer_id -> control fd
+static std::map<std::string, int> g_pending_dials;  // token -> dialer fd
+
+static void set_nonblock(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+static void update_events(Conn* c) {
+  epoll_event ev{};
+  ev.events = EPOLLIN | (c->want_write ? EPOLLOUT : 0);
+  ev.data.fd = c->fd;
+  epoll_ctl(g_epoll, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+static void close_conn(int fd);
+
+static void queue_write(Conn* c, const char* data, size_t len) {
+  c->outbuf.append(data, len);
+  if (!c->want_write) {
+    c->want_write = true;
+    update_events(c);
+  }
+}
+
+static void queue_frame(Conn* c, const std::string& payload) {
+  uint32_t n = htonl((uint32_t)payload.size());
+  std::string frame((char*)&n, 4);
+  frame += payload;
+  queue_write(c, frame.data(), frame.size());
+}
+
+static void close_conn(int fd) {
+  auto it = g_conns.find(fd);
+  if (it == g_conns.end()) return;
+  Conn* c = it->second;
+  if (!c->peer_id.empty()) {
+    auto reg = g_control.find(c->peer_id);
+    if (reg != g_control.end() && reg->second == fd) g_control.erase(reg);
+  }
+  if (!c->token.empty()) {
+    auto pend = g_pending_dials.find(c->token);
+    if (pend != g_pending_dials.end() && pend->second == fd) g_pending_dials.erase(pend);
+  }
+  int partner = c->peer_fd;
+  epoll_ctl(g_epoll, EPOLL_CTL_DEL, fd, nullptr);
+  close(fd);
+  g_conns.erase(it);
+  delete c;
+  if (partner >= 0) {
+    auto pit = g_conns.find(partner);
+    if (pit != g_conns.end()) {
+      pit->second->peer_fd = -1;
+      close_conn(partner);  // pipe is bidirectional: one side gone, tear down both
+    }
+  }
+}
+
+static void splice_pair(Conn* a, Conn* b) {
+  a->peer_fd = b->fd;
+  b->peer_fd = a->fd;
+  a->state = b->state = ConnState::Spliced;
+  const char ok[] = {0, 0, 0, 1, 'O'};
+  queue_write(a, ok, 5);
+  queue_write(b, ok, 5);
+  // any bytes that raced ahead of the match are forwarded
+  if (!a->inbuf.empty()) { queue_write(b, a->inbuf.data(), a->inbuf.size()); a->inbuf.clear(); }
+  if (!b->inbuf.empty()) { queue_write(a, b->inbuf.data(), b->inbuf.size()); b->inbuf.clear(); }
+}
+
+static void handle_control_frame(Conn* c, const std::string& payload) {
+  if (payload.empty()) { close_conn(c->fd); return; }
+  char kind = payload[0];
+  if (kind == 'R') {
+    c->peer_id = payload.substr(1);
+    if (c->peer_id.empty()) { close_conn(c->fd); return; }
+    auto old = g_control.find(c->peer_id);
+    if (old != g_control.end() && old->second != c->fd) close_conn(old->second);
+    g_control[c->peer_id] = c->fd;
+    c->state = ConnState::Control;
+    queue_frame(c, "O");
+  } else if (kind == 'D' && payload.size() > 17) {
+    std::string token = payload.substr(1, 16);
+    std::string target = payload.substr(17);
+    auto reg = g_control.find(target);
+    if (reg == g_control.end()) { queue_frame(c, "E"); close_conn(c->fd); return; }
+    c->token = token;
+    c->state = ConnState::SplicedWaiting;
+    g_pending_dials[token] = c->fd;
+    c->created_ms = now_ms();
+    queue_frame(g_conns[reg->second], std::string("I") + token);
+  } else if (kind == 'A' && payload.size() >= 17) {
+    std::string token = payload.substr(1, 16);
+    auto pend = g_pending_dials.find(token);
+    if (pend == g_pending_dials.end()) { queue_frame(c, "E"); close_conn(c->fd); return; }
+    Conn* dialer = g_conns[pend->second];
+    g_pending_dials.erase(pend);
+    dialer->token.clear();
+    splice_pair(dialer, c);
+  } else {
+    close_conn(c->fd);
+  }
+}
+
+static void on_readable(Conn* c) {
+  char buf[SPLICE_BUF];
+  while (true) {
+    ssize_t n = read(c->fd, buf, sizeof(buf));
+    if (n == 0) { close_conn(c->fd); return; }
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      close_conn(c->fd); return;
+    }
+    if (c->state == ConnState::Spliced) {
+      auto pit = g_conns.find(c->peer_fd);
+      if (pit == g_conns.end()) { close_conn(c->fd); return; }
+      queue_write(pit->second, buf, n);
+      // backpressure: stop reading while the partner's buffer is large
+      if (pit->second->outbuf.size() > 8 * SPLICE_BUF) break;
+    } else {
+      c->inbuf.append(buf, n);
+      while (c->state != ConnState::Spliced && c->inbuf.size() >= 4) {
+        uint32_t len = ntohl(*(uint32_t*)c->inbuf.data());
+        if (len > MAX_FRAME) { close_conn(c->fd); return; }
+        if (c->inbuf.size() < 4 + len) break;
+        std::string payload = c->inbuf.substr(4, len);
+        c->inbuf.erase(0, 4 + len);
+        handle_control_frame(c, payload);
+        if (g_conns.find(c->fd) == g_conns.end()) return;  // frame handler closed us
+      }
+    }
+  }
+}
+
+static void on_writable(Conn* c) {
+  while (!c->outbuf.empty()) {
+    ssize_t n = write(c->fd, c->outbuf.data(), c->outbuf.size());
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+      close_conn(c->fd); return;
+    }
+    c->outbuf.erase(0, n);
+  }
+  c->want_write = false;
+  update_events(c);
+}
+
+int main(int argc, char** argv) {
+  int port = argc > 1 ? atoi(argv[1]) : 34000;
+  signal(SIGPIPE, SIG_IGN);
+
+  int listener = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(listener, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(listener, (sockaddr*)&addr, sizeof(addr)) < 0) { perror("bind"); return 1; }
+  if (listen(listener, 128) < 0) { perror("listen"); return 1; }
+  set_nonblock(listener);
+
+  socklen_t alen = sizeof(addr);
+  getsockname(listener, (sockaddr*)&addr, &alen);
+  printf("relay listening on port %d\n", ntohs(addr.sin_port));
+  fflush(stdout);
+
+  g_epoll = epoll_create1(0);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = listener;
+  epoll_ctl(g_epoll, EPOLL_CTL_ADD, listener, &ev);
+
+  std::vector<epoll_event> events(256);
+  double last_sweep = now_ms();
+  while (true) {
+    int n = epoll_wait(g_epoll, events.data(), (int)events.size(), 1000);
+    for (int i = 0; i < n; i++) {
+      int fd = events[i].data.fd;
+      if (fd == listener) {
+        while (true) {
+          int client = accept(listener, nullptr, nullptr);
+          if (client < 0) break;
+          set_nonblock(client);
+          setsockopt(client, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          Conn* c = new Conn();
+          c->fd = client;
+          c->created_ms = now_ms();
+          g_conns[client] = c;
+          epoll_event cev{};
+          cev.events = EPOLLIN;
+          cev.data.fd = client;
+          epoll_ctl(g_epoll, EPOLL_CTL_ADD, client, &cev);
+        }
+        continue;
+      }
+      auto it = g_conns.find(fd);
+      if (it == g_conns.end()) continue;
+      if (events[i].events & (EPOLLERR | EPOLLHUP)) { close_conn(fd); continue; }
+      if (events[i].events & EPOLLIN) on_readable(it->second);
+      if (g_conns.find(fd) == g_conns.end()) continue;
+      if (events[i].events & EPOLLOUT) on_writable(it->second);
+    }
+    if (now_ms() - last_sweep > 5000) {  // expire unmatched dials
+      last_sweep = now_ms();
+      std::vector<int> expired;
+      for (auto& [token, fd] : g_pending_dials) {
+        auto it = g_conns.find(fd);
+        if (it == g_conns.end() || now_ms() - it->second->created_ms > PENDING_DIAL_TTL_MS)
+          expired.push_back(fd);
+      }
+      for (int fd : expired) close_conn(fd);
+    }
+  }
+}

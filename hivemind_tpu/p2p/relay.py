@@ -1,0 +1,128 @@
+"""Client for the native relay daemon (hivemind_tpu/native/relay_daemon.cpp) — the
+circuit-relay capability: a firewalled peer registers over an OUTBOUND connection and
+becomes dialable as ``/ip4/<relay>/tcp/<port>/p2p-circuit/p2p/<peer>`` (role parity:
+reference p2p_daemon.py:114-137 auto-relay). The relay splices raw bytes; the normal
+end-to-end Noise handshake runs straight through it, so the relay never sees
+plaintext."""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import os
+import struct
+from typing import Optional, Tuple
+
+from hivemind_tpu.p2p.crypto_channel import handshake
+from hivemind_tpu.p2p.mux import MuxConnection
+from hivemind_tpu.p2p.peer_id import PeerID
+from hivemind_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+async def _send_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
+    writer.write(struct.pack(">I", len(payload)) + payload)
+    await writer.drain()
+
+
+async def _recv_frame(reader: asyncio.StreamReader) -> bytes:
+    header = await reader.readexactly(4)
+    (length,) = struct.unpack(">I", header)
+    return await reader.readexactly(length)
+
+
+class RelayClient:
+    """Attach a P2P node to a relay daemon.
+
+    ``await RelayClient.create(p2p, host, port)`` registers the node; incoming
+    relayed dials are accepted automatically and served like direct connections.
+    ``dial(peer_id)`` connects to a registered peer through the relay."""
+
+    def __init__(self, p2p, host: str, port: int):
+        self.p2p = p2p
+        self.host, self.port = host, port
+        self._control_writer: Optional[asyncio.StreamWriter] = None
+        self._control_task: Optional[asyncio.Task] = None
+
+    @classmethod
+    async def create(cls, p2p, host: str, port: int) -> "RelayClient":
+        self = cls(p2p, host, port)
+        await self._register()
+        return self
+
+    async def _register(self) -> None:
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        await _send_frame(writer, b"R" + self.p2p.peer_id.to_bytes())
+        response = await _recv_frame(reader)
+        if response != b"O":
+            raise ConnectionError(f"relay refused registration: {response!r}")
+        self._control_writer = writer
+        self._control_task = asyncio.create_task(self._control_loop(reader))
+        logger.info(f"registered at relay {self.host}:{self.port} as {self.p2p.peer_id}")
+
+    async def _control_loop(self, reader: asyncio.StreamReader) -> None:
+        """Wait for INCOMING notifications and accept each relayed dial."""
+        try:
+            while True:
+                frame = await _recv_frame(reader)
+                if frame[:1] == b"I" and len(frame) >= 17:
+                    token = frame[1:17]
+                    asyncio.create_task(self._accept(token))
+        except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
+            logger.warning(f"relay control line lost: {e!r}")
+
+    async def _accept(self, token: bytes) -> None:
+        try:
+            reader, writer = await asyncio.open_connection(self.host, self.port)
+            await _send_frame(writer, b"A" + token)
+            response = await _recv_frame(reader)
+            if response != b"O":
+                writer.close()
+                return
+            # from here the socket is a transparent pipe to the dialer: run the
+            # normal inbound path (handshake as responder, then mux)
+            await self.p2p._on_inbound_connection(reader, writer)
+        except Exception as e:
+            logger.warning(f"relayed accept failed: {e!r}")
+
+    async def dial(self, target: PeerID) -> PeerID:
+        """Connect to a relay-registered peer; returns its authenticated PeerID and
+        installs the connection in the P2P node like any direct dial."""
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        token = os.urandom(16)
+        await _send_frame(writer, b"D" + token + target.to_bytes())
+        try:
+            response = await _recv_frame(reader)
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            # the daemon may close right after its error frame; either way: no route
+            writer.close()
+            raise ConnectionError(f"relay could not reach {target}") from None
+        if response != b"O":
+            writer.close()
+            raise ConnectionError(f"relay could not reach {target}: {response!r}")
+        channel, extras = await handshake(
+            reader, writer, self.p2p.identity, is_initiator=True,
+            announced_addrs=self.p2p.get_visible_maddrs(),
+        )
+        from hivemind_tpu.utils.crypto import Ed25519PublicKey
+        from hivemind_tpu.p2p.crypto_channel import HandshakeError
+
+        peer_id = PeerID.from_public_key(Ed25519PublicKey.from_bytes(extras["static"]))
+        if peer_id != target:
+            channel.close()
+            raise HandshakeError(f"dialed {target} via relay but found {peer_id}")
+        conn = MuxConnection(channel, peer_id, is_initiator=True, on_inbound_stream=self.p2p._route_stream)
+        existing = self.p2p._connections.get(peer_id)
+        if existing is None or existing.is_closed:
+            self.p2p._connections[peer_id] = conn
+        self.p2p._all_connections.add(conn)
+        conn.start()
+        return peer_id
+
+    async def close(self) -> None:
+        if self._control_task is not None:
+            self._control_task.cancel()
+        if self._control_writer is not None:
+            with contextlib.suppress(Exception):
+                self._control_writer.close()

@@ -28,10 +28,16 @@ class AuthorizationError(RuntimeError):
 
 class AuthorizerBase(ABC):
     @abstractmethod
-    def issue_token(self) -> bytes: ...
+    def issue_token(self) -> bytes:
+        """Authority-side: create a token for oneself."""
 
     @abstractmethod
-    def validate_token(self, token: bytes) -> bool: ...
+    def get_local_token(self) -> bytes:
+        """The token to stamp on outgoing requests."""
+
+    @abstractmethod
+    def validate_token(self, token: bytes, sender_peer_id: Optional[Any] = None) -> bool:
+        """Check a presented token, optionally bound to the authenticated sender."""
 
 
 class TokenAuthorizerBase(AuthorizerBase):
@@ -65,6 +71,12 @@ class TokenAuthorizerBase(AuthorizerBase):
 
     def set_access_token(self, token: bytes) -> None:
         """Install a token granted by the authority (delivered out-of-band)."""
+        try:
+            payload, _sig = MSGPackSerializer.loads(token)
+            _pub, expiry, _nonce = MSGPackSerializer.loads(payload)
+            self._access_token_expiry = float(expiry)
+        except Exception:
+            self._access_token_expiry = None
         self.access_token = token
 
     def issue_token_for(self, client_public_key: Ed25519PublicKey) -> bytes:
@@ -81,8 +93,12 @@ class TokenAuthorizerBase(AuthorizerBase):
 
     def get_local_token(self) -> bytes:
         """The token this peer stamps on requests: the granted one, or self-issued if
-        this peer IS the authority."""
+        this peer IS the authority. Raises loudly when the granted token expired
+        (silent stamping of a dead token would fail remotely with no local signal)."""
         if self.access_token is not None:
+            expiry = getattr(self, "_access_token_expiry", None)
+            if expiry is not None and get_dht_time() > expiry:
+                raise AuthorizationError("access token expired; obtain a fresh one from the authority")
             return self.access_token
         if self.authority_key is not None:
             return self.issue_token()
@@ -159,35 +175,43 @@ class AuthRPCWrapper:
                 if peer is not None:
                     peer.auth_token = authorizer.get_local_token()
 
-        def _prepare(request, args):
-            """Stream-input RPCs pass an iterator as the first argument: check/stamp
-            the FIRST message lazily instead of the iterator object itself."""
+        async def _prepare(request, args):
+            """Stream-input RPCs pass an iterator as the first argument: the auth
+            check happens EAGERLY on the first message, before the handler runs (an
+            empty or stalling stream must not reach the handler unauthenticated)."""
             context = args[0] if args else None
             if hasattr(request, "__aiter__"):
+                iterator = request.__aiter__()
+                try:
+                    first_message = await iterator.__anext__()
+                except StopAsyncIteration:
+                    raise AuthorizationError(f"{name}: empty request stream") from None
+                _check_or_stamp(first_message, context)
 
-                async def checked():
-                    first = True
-                    async for message in request:
-                        if first:
-                            _check_or_stamp(message, context)
-                            first = False
+                async def chained():
+                    yield first_message
+                    async for message in iterator:
                         yield message
 
-                return checked()
+                return chained()
             _check_or_stamp(request, context)
             return request
 
         if inspect.isasyncgenfunction(attr):
 
             async def stream_wrapped(request, *args, **kwargs):
-                request = _prepare(request, args)
+                request = await _prepare(request, args)
                 async for item in attr(request, *args, **kwargs):
                     yield item
 
             return stream_wrapped
 
         async def wrapped(request, *args, **kwargs):
-            request = _prepare(request, args)
-            return await attr(request, *args, **kwargs)
+            request = await _prepare(request, args)
+            result = attr(request, *args, **kwargs)
+            if hasattr(result, "__aiter__"):
+                # a stub's stream-output caller returns an async iterator, not a coroutine
+                return result
+            return await result
 
         return wrapped

@@ -1,0 +1,76 @@
+"""Native relay daemon: a peer reachable only through the relay serves RPCs end-to-end
+encrypted (scope: reference tests/test_relays.py circuit-relay reachability)."""
+
+import asyncio
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from hivemind_tpu.p2p import P2P, P2PContext
+from hivemind_tpu.p2p.relay import RelayClient
+from hivemind_tpu.proto import test_pb2
+
+NATIVE_DIR = Path(__file__).parent.parent / "hivemind_tpu" / "native"
+RELAY_BIN = NATIVE_DIR / "relay_daemon"
+
+
+@pytest.fixture(scope="module")
+def relay_process():
+    if not RELAY_BIN.exists():
+        subprocess.run(["make"], cwd=NATIVE_DIR, check=True, capture_output=True)
+    proc = subprocess.Popen(
+        [str(RELAY_BIN), "0"], stdout=subprocess.PIPE, text=True
+    )
+    line = proc.stdout.readline()
+    port = int(line.strip().rsplit(" ", 1)[-1])
+    yield port
+    proc.kill()
+    proc.wait()
+
+
+async def test_relayed_rpc_end_to_end(relay_process):
+    port = relay_process
+    # "firewalled" peer: registers at the relay, never shares its direct address
+    server = await P2P.create()
+    client = await P2P.create()
+
+    async def triple(request: test_pb2.TestRequest, context: P2PContext) -> test_pb2.TestResponse:
+        return test_pb2.TestResponse(number=request.number * 3)
+
+    await server.add_protobuf_handler("triple", triple, test_pb2.TestRequest)
+    server_relay = await RelayClient.create(server, "127.0.0.1", port)
+
+    client_relay = RelayClient(client, "127.0.0.1", port)
+    peer = await client_relay.dial(server.peer_id)
+    assert peer == server.peer_id
+
+    response = await client.call_protobuf_handler(
+        server.peer_id, "triple", test_pb2.TestRequest(number=14), test_pb2.TestResponse
+    )
+    assert response.number == 42
+
+    # a second call reuses the spliced connection
+    response = await client.call_protobuf_handler(
+        server.peer_id, "triple", test_pb2.TestRequest(number=100), test_pb2.TestResponse
+    )
+    assert response.number == 300
+
+    await server_relay.close()
+    await client.shutdown()
+    await server.shutdown()
+
+
+async def test_relay_dial_unknown_peer(relay_process):
+    port = relay_process
+    client = await P2P.create()
+    from hivemind_tpu.utils.crypto import Ed25519PrivateKey
+    from hivemind_tpu.p2p.peer_id import PeerID
+
+    ghost = PeerID.from_private_key(Ed25519PrivateKey())
+    relay = RelayClient(client, "127.0.0.1", port)
+    with pytest.raises(ConnectionError):
+        await relay.dial(ghost)
+    await client.shutdown()
