@@ -1,0 +1,109 @@
+"""Collaborative ALBERT pretraining peer (capability parity: reference
+examples/albert/run_trainer.py — the flagship recipe: every peer runs this script,
+joins the swarm via the DHT, and trains one shared ALBERT with the collaborative
+Optimizer; peers may come and go at any time).
+
+Trains on synthetic MLM data so the recipe runs anywhere (real-data wiring via
+HuggingFace datasets is a round-2 item, see docs/design_notes.md)."""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--run_id", default="albert_demo")
+    parser.add_argument("--initial_peers", nargs="*", default=[])
+    parser.add_argument("--target_batch_size", type=int, default=4096)
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--seq_len", type=int, default=128)
+    parser.add_argument("--learning_rate", type=float, default=1e-3)
+    parser.add_argument("--warmup_epochs", type=int, default=100)
+    parser.add_argument("--total_epochs", type=int, default=10_000)
+    parser.add_argument("--matchmaking_time", type=float, default=3.0)
+    parser.add_argument("--max_steps", type=int, default=10**9)
+    parser.add_argument("--client_mode", action="store_true")
+    parser.add_argument("--tiny", action="store_true", help="albert-tiny config (CPU-friendly)")
+    parser.add_argument("--powersgd_rank", type=int, default=0, help=">0: PowerSGD gradient compression")
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from hivemind_tpu.dht import DHT
+    from hivemind_tpu.models import AlbertConfig, AlbertForMaskedLM, make_synthetic_mlm_batch, mlm_loss
+    from hivemind_tpu.optim import Optimizer
+    from hivemind_tpu.utils.logging import get_logger
+
+    logger = get_logger("albert_trainer")
+
+    dht = DHT(initial_peers=args.initial_peers, start=True)
+    for maddr in dht.get_visible_maddrs():
+        logger.info(f"to join this training run: --initial_peers {maddr}")
+
+    config = AlbertConfig.tiny(max_position=args.seq_len) if args.tiny else AlbertConfig.base(max_position=args.seq_len)
+    model = AlbertForMaskedLM(config)
+    sample = make_synthetic_mlm_batch(jax.random.PRNGKey(0), config, args.batch_size, args.seq_len)
+    params = model.init(jax.random.PRNGKey(0), sample["input_ids"][:1, :8])["params"]
+
+    @jax.jit
+    def loss_and_grad(params, batch):
+        def fn(p):
+            logits = model.apply({"params": p}, batch["input_ids"])
+            return mlm_loss(logits, batch["labels"], batch["mlm_mask"])
+
+        return jax.value_and_grad(fn)(params)
+
+    grad_averager_factory = None
+    grad_averager_opts = {}
+    if args.powersgd_rank > 0:
+        from hivemind_tpu.optim import PowerSGDGradientAverager
+
+        logger.info(f"using PowerSGD rank {args.powersgd_rank} gradient compression")
+        grad_averager_factory = PowerSGDGradientAverager
+        grad_averager_opts = {"averager_rank": args.powersgd_rank}
+    # the reference ALBERT recipe trains with LAMB + linear warmup + clipping;
+    # schedules are epoch-keyed (one optax update per virtual epoch)
+    from hivemind_tpu.moe.server.layers import lamb_with_warmup
+
+    opt = Optimizer(
+        dht=dht,
+        run_id=args.run_id,
+        target_batch_size=args.target_batch_size,
+        params=params,
+        optimizer=lamb_with_warmup(args.learning_rate, args.warmup_epochs, args.total_epochs),
+        batch_size_per_step=args.batch_size,
+        matchmaking_time=args.matchmaking_time,
+        client_mode=args.client_mode,
+        grad_averager_factory=grad_averager_factory,
+        grad_averager_opts=grad_averager_opts,
+        verbose=True,
+    )
+
+    rng = jax.random.PRNGKey(int(time.time() * 1000) % 2**31)
+    step = 0
+    loss_ema = None
+    while step < args.max_steps:
+        rng, batch_rng = jax.random.split(rng)
+        batch = make_synthetic_mlm_batch(batch_rng, config, args.batch_size, args.seq_len)
+        loss, grads = loss_and_grad(opt.params, batch)
+        opt.step(grads)
+        loss_value = float(loss)
+        loss_ema = loss_value if loss_ema is None else 0.95 * loss_ema + 0.05 * loss_value
+        step += 1
+        if step % 10 == 0:
+            progress = opt.tracker.global_progress
+            logger.info(
+                f"step {step} epoch {opt.local_epoch} loss {loss_ema:.4f} "
+                f"(swarm: {progress.num_peers} peers, {progress.samples_accumulated}/"
+                f"{args.target_batch_size} samples)"
+            )
+
+
+if __name__ == "__main__":
+    main()

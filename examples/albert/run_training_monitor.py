@@ -1,0 +1,62 @@
+"""Training monitor: join the swarm as a non-training observer and report global
+progress (capability parity: reference examples/albert/run_training_monitor.py —
+aggregates per-peer metrics from the DHT; wandb hookup optional)."""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--run_id", default="albert_demo")
+    parser.add_argument("--initial_peers", nargs="*", required=True)
+    parser.add_argument("--refresh_period", type=float, default=5.0)
+    args = parser.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.devices()
+
+    from hivemind_tpu.dht import DHT, Ed25519SignatureValidator
+    from hivemind_tpu.optim.progress_tracker import LocalTrainingProgress
+    from hivemind_tpu.utils.logging import get_logger
+    from hivemind_tpu.utils.timed_storage import get_dht_time
+
+    logger = get_logger("monitor")
+    # progress records are signature-protected: without this validator their
+    # signatures are never stripped and the records fail to deserialize
+    dht = DHT(
+        initial_peers=args.initial_peers,
+        start=True,
+        record_validators=[Ed25519SignatureValidator()],
+    )
+    progress_key = f"{args.run_id}_progress"
+
+    while True:
+        time.sleep(args.refresh_period)
+        result = dht.get(progress_key, latest=True)
+        if result is None or not isinstance(result.value, dict):
+            logger.info("no training peers visible yet")
+            continue
+        records = []
+        for entry in result.value.values():
+            try:
+                records.append(LocalTrainingProgress.model_validate(entry.value))
+            except Exception:
+                continue
+        if not records:
+            continue
+        epoch = max(r.epoch for r in records)
+        samples = sum(r.samples_accumulated for r in records if r.epoch == epoch)
+        sps = sum(r.samples_per_second for r in records if r.epoch == epoch)
+        logger.info(
+            f"epoch {epoch}: {len(records)} peers, {samples} samples accumulated, "
+            f"{sps:.0f} samples/s aggregate"
+        )
+
+
+if __name__ == "__main__":
+    main()

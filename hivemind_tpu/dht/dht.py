@@ -18,7 +18,7 @@ from hivemind_tpu.dht.routing import DHTKey, Subkey
 from hivemind_tpu.dht.validation import CompositeValidator, RecordValidatorBase
 from hivemind_tpu.p2p import Multiaddr, P2P, PeerID
 from hivemind_tpu.utils.logging import get_logger
-from hivemind_tpu.utils.loop import LoopRunner, get_loop_runner
+from hivemind_tpu.utils.loop import EventLoopShutdownError, LoopRunner, get_loop_runner
 from hivemind_tpu.utils.timed_storage import DHTExpiration, ValueWithExpiration, get_dht_time
 
 logger = get_logger(__name__)
@@ -83,7 +83,13 @@ class DHT:
         if self._node is not None:
             node, self._node = self._node, None
             self.is_alive = False
-            self._runner.run_coroutine(node.shutdown())
+            coro = node.shutdown()
+            try:
+                self._runner.run_coroutine(coro)
+            except EventLoopShutdownError:
+                coro.close()  # loop already gone: release the un-awaited coroutine
+            except Exception as e:
+                logger.warning(f"DHT node shutdown raised: {e!r}")
 
     def __enter__(self) -> "DHT":
         if self._node is None:

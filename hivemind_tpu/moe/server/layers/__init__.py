@@ -11,3 +11,8 @@ from hivemind_tpu.moe.server.layers.common import (
     name_to_input,
     register_expert_class,
 )
+from hivemind_tpu.moe.server.layers.optim import (
+    clipped,
+    lamb_with_warmup,
+    linear_warmup_schedule,
+)

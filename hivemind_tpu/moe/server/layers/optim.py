@@ -1,0 +1,30 @@
+"""Expert-side optimizer helpers (capability parity: reference
+hivemind/moe/server/layers/optim.py ClippingWrapper + layers/lr_schedule.py) —
+expressed as optax combinators rather than a torch optimizer wrapper."""
+
+from __future__ import annotations
+
+import optax
+
+
+def clipped(optimizer: optax.GradientTransformation, clip_norm: float = 1.0) -> optax.GradientTransformation:
+    """Global-norm gradient clipping around any optax optimizer (the reference's
+    ClippingWrapper role)."""
+    return optax.chain(optax.clip_by_global_norm(clip_norm), optimizer)
+
+
+def linear_warmup_schedule(peak_lr: float, warmup_steps: int, total_steps: int) -> optax.Schedule:
+    """Linear warmup then linear decay (the reference's get_linear_schedule_with_warmup)."""
+    return optax.join_schedules(
+        [
+            optax.linear_schedule(0.0, peak_lr, warmup_steps),
+            optax.linear_schedule(peak_lr, 0.0, max(total_steps - warmup_steps, 1)),
+        ],
+        boundaries=[warmup_steps],
+    )
+
+
+def lamb_with_warmup(peak_lr: float, warmup_steps: int, total_steps: int, clip_norm: float = 1.0):
+    """The ALBERT-recipe optimizer: LAMB + warmup schedule + clipping (the reference
+    trains ALBERT with Lamb, examples/albert/run_trainer.py)."""
+    return clipped(optax.lamb(linear_warmup_schedule(peak_lr, warmup_steps, total_steps)), clip_norm)

@@ -70,6 +70,7 @@ class Optimizer:
         state_averaging_compression: CompressionBase = Float16Compression(),
         target_group_size: Optional[int] = None,
         min_group_size: int = 2,
+        grad_averager_factory=None,
         grad_averager_opts: Optional[dict] = None,
         state_averager_opts: Optional[dict] = None,
         tracker_opts: Optional[dict] = None,
@@ -124,7 +125,8 @@ class Optimizer:
                 # aux peers need the schema to join groups; fetch it lazily from peers
                 # is future work — for now aux requires params_like via grad_averager_opts
                 tensors_like = (grad_averager_opts or {}).pop("tensors_like", [])
-            self.grad_averager = GradientAverager(
+            factory = grad_averager_factory if grad_averager_factory is not None else GradientAverager
+            self.grad_averager = factory(
                 tensors_like,
                 dht=dht,
                 prefix=f"{run_id}_grad_averager",
