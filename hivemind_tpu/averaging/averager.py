@@ -133,7 +133,7 @@ class DecentralizedAverager(ServicerBase):
     async def _setup(self) -> None:
         if self._ready.is_set():
             return
-        self.p2p: P2P = self.dht.node.p2p
+        self.p2p: P2P = await self.dht.replicate_p2p()
         self.peer_id: PeerID = self.p2p.peer_id
         self._allreduce_registered = asyncio.Condition()
         self.key_manager = GroupKeyManager(

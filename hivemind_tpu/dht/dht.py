@@ -142,6 +142,13 @@ class DHT:
         future = self._runner.run_coroutine(_wrap(), return_future=True)
         return future if return_future else future.result()
 
+    async def replicate_p2p(self) -> P2P:
+        """The underlying transport, for components that share this peer's identity
+        and connections (averagers, MoE). Async for drop-in parity with the reference
+        API (dht.py:320-333 attaches a second daemon client and is awaited at every
+        call site); in-process there is exactly one P2P to share."""
+        return self.node.p2p
+
     def add_validators(self, record_validators: Iterable[RecordValidatorBase]) -> None:
         """Merge extra validators; must be called after start (parity with reference
         semantics where validators are extended post-init, dht.py add_validators)."""

@@ -107,7 +107,7 @@ class Server:
             future.result(timeout)
 
     async def _start(self) -> None:
-        await self.handler.add_p2p_handlers(self.dht.node.p2p)
+        await self.handler.add_p2p_handlers(await self.dht.replicate_p2p())
         self.runtime.start()
         if self.checkpoint_saver is not None:
             self.checkpoint_saver.start()
@@ -132,7 +132,7 @@ class Server:
             if self.checkpoint_saver is not None:
                 self.checkpoint_saver.shutdown()
             with contextlib.suppress(Exception):
-                await self.handler.remove_p2p_handlers(self.dht.node.p2p)
+                await self.handler.remove_p2p_handlers(await self.dht.replicate_p2p())
 
         with contextlib.suppress(Exception):
             self._runner.run_coroutine(_stop(), return_future=True).result(5.0)

@@ -1,0 +1,23 @@
+"""Raise OS file-descriptor limits (capability parity: reference
+hivemind/utils/limits.py) — swarm peers hold many sockets."""
+
+from __future__ import annotations
+
+from hivemind_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def increase_file_limit(new_soft: int = 2**15, new_hard: int = 2**15) -> None:
+    """Best-effort bump of RLIMIT_NOFILE up to the allowed hard limit."""
+    try:
+        import resource
+
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        target_hard = max(hard, new_hard) if hard == resource.RLIM_INFINITY or new_hard <= hard else hard
+        target_soft = min(max(soft, new_soft), target_hard if target_hard != resource.RLIM_INFINITY else new_soft)
+        if target_soft > soft:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (target_soft, target_hard))
+            logger.info(f"raised file limit: {soft} -> {target_soft}")
+    except Exception as e:
+        logger.warning(f"could not increase file limit: {e!r}")

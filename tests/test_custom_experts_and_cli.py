@@ -1,0 +1,84 @@
+"""Custom expert registration end-to-end + CLI smoke tests
+(scope: reference tests/test_custom_experts.py, test_cli_scripts.py, test_start_server.py)."""
+
+import subprocess
+import sys
+import time
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+
+def test_register_custom_expert_end_to_end():
+    from hivemind_tpu.dht import DHT
+    from hivemind_tpu.moe import RemoteExpert, Server, get_experts, register_expert_class
+
+    class GatedExpert(nn.Module):
+        hidden_dim: int
+
+        @nn.compact
+        def __call__(self, x):
+            gate = nn.sigmoid(nn.Dense(self.hidden_dim)(x))
+            return x * gate
+
+    register_expert_class("gated_test", lambda batch, hid: np.zeros((batch, hid), np.float32))(GatedExpert)
+
+    server = Server.create(
+        expert_uids=["gated_test_grid.0"], expert_cls="gated_test", hidden_dim=16,
+        start=True, optim_factory=lambda: optax.sgd(1e-3),
+    )
+    try:
+        time.sleep(1.0)
+        info = get_experts(server.dht, ["gated_test_grid.0"])[0]
+        assert info is not None
+        client_dht = DHT(initial_peers=[str(m) for m in server.dht.get_visible_maddrs()], start=True)
+        expert = RemoteExpert(info, client_dht.node.p2p)
+        x = jnp.asarray(np.random.RandomState(0).randn(3, 16), jnp.float32)
+        out = expert(x)
+        backend = server.backends["gated_test_grid.0"]
+        expected = backend.module.apply({"params": backend.params}, x)
+        assert np.allclose(np.asarray(out), np.asarray(expected), atol=1e-4)
+        client_dht.shutdown()
+    finally:
+        server.shutdown()
+        server.dht.shutdown()
+
+
+@pytest.mark.parametrize(
+    "module,extra",
+    [
+        ("hivemind_tpu.hivemind_cli.run_dht", ["--refresh_period", "1"]),
+        (
+            "hivemind_tpu.hivemind_cli.run_server",
+            ["--expert_uids", "cli_test.0", "--hidden_dim", "16", "--expert_cls", "ffn"],
+        ),
+    ],
+    ids=["run_dht", "run_server"],
+)
+def test_cli_starts_and_listens(module, extra):
+    """The real CLI entrypoints come up and announce a dialable address."""
+    import os
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": "."}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", module, *extra],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+    try:
+        deadline = time.monotonic() + 60
+        saw_listening = False
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if "listening" in line:
+                saw_listening = True
+                break
+            if proc.poll() is not None:
+                break
+        assert saw_listening, f"{module} never announced a listening address"
+    finally:
+        proc.kill()
+        proc.wait()
