@@ -24,7 +24,14 @@ def main():
     parser.add_argument("--initial_peers", nargs="*", default=[])
     parser.add_argument("--checkpoint_dir", default=None)
     parser.add_argument("--learning_rate", type=float, default=1e-3)
+    parser.add_argument("--increase_file_limit", action="store_true",
+                        help="raise RLIMIT_NOFILE for many concurrent connections")
     args = parser.parse_args()
+
+    if args.increase_file_limit:
+        from hivemind_tpu.utils.limits import increase_file_limit
+
+        increase_file_limit()
 
     import optax
 

@@ -43,7 +43,9 @@ class RemoteMixtureOfExperts:
         seed: int = 0,
     ):
         self.dht = dht
-        self.p2p: P2P = dht.node.p2p
+        from hivemind_tpu.utils.loop import get_loop_runner
+
+        self.p2p: P2P = get_loop_runner().run_coroutine(dht.replicate_p2p())
         self.grid_size = tuple(grid_size)
         self.k_best, self.k_min = k_best, k_min
         self.beam_size = beam_size if beam_size is not None else k_best * 2

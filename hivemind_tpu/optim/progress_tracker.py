@@ -101,7 +101,7 @@ class ProgressTracker:
         if private_key is None:
             # sign with THIS peer's transport identity (not the process-wide singleton:
             # several in-process peers would collide on one subkey)
-            private_key = dht.node.p2p.identity
+            private_key = self._runner.run_coroutine(dht.replicate_p2p()).identity
         signature_validator = Ed25519SignatureValidator(private_key)
         progress_key_name = f"{prefix}_progress"
         schema = pydantic.create_model(

@@ -14,8 +14,14 @@ def increase_file_limit(new_soft: int = 2**15, new_hard: int = 2**15) -> None:
         import resource
 
         soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
-        target_hard = max(hard, new_hard) if hard == resource.RLIM_INFINITY or new_hard <= hard else hard
-        target_soft = min(max(soft, new_soft), target_hard if target_hard != resource.RLIM_INFINITY else new_soft)
+        if hard == resource.RLIM_INFINITY:
+            # never LOWER an unlimited hard limit (RLIM_INFINITY is -1: naive max()
+            # would irreversibly clamp it)
+            target_hard = resource.RLIM_INFINITY
+            target_soft = max(soft, new_soft)
+        else:
+            target_hard = hard
+            target_soft = min(max(soft, new_soft), hard)
         if target_soft > soft:
             resource.setrlimit(resource.RLIMIT_NOFILE, (target_soft, target_hard))
             logger.info(f"raised file limit: {soft} -> {target_soft}")

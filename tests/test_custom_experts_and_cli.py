@@ -69,16 +69,27 @@ def test_cli_starts_and_listens(module, extra):
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
     )
     try:
+        import selectors
+
+        # select-based read loop: a silent-but-alive child must FAIL at the deadline,
+        # not block the whole suite inside readline()
+        sel = selectors.DefaultSelector()
+        sel.register(proc.stdout, selectors.EVENT_READ)
         deadline = time.monotonic() + 60
         saw_listening = False
-        while time.monotonic() < deadline:
-            line = proc.stdout.readline()
-            if "listening" in line:
+        buffer = ""
+        while time.monotonic() < deadline and not saw_listening:
+            if not sel.select(timeout=1.0):
+                if proc.poll() is not None:
+                    break
+                continue
+            chunk = proc.stdout.readline()
+            if not chunk:
+                break
+            buffer += chunk
+            if "listening" in chunk:
                 saw_listening = True
-                break
-            if proc.poll() is not None:
-                break
-        assert saw_listening, f"{module} never announced a listening address"
+        assert saw_listening, f"{module} never announced a listening address; output: {buffer[-500:]}"
     finally:
         proc.kill()
         proc.wait()

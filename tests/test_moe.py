@@ -147,6 +147,19 @@ def test_remote_mixture_of_experts():
         server.dht.shutdown()
 
 
+def test_background_server_contextmanager():
+    from hivemind_tpu.moe import background_server
+
+    with background_server(
+        expert_uids=["bgctx.0"], expert_cls="nop", hidden_dim=8,
+        optim_factory=lambda: optax.sgd(1e-3),
+    ) as (dht, server):
+        assert dht.is_alive and "bgctx.0" in server.backends
+        out = server.backends["bgctx.0"].forward(np.ones((2, 8), np.float32))
+        assert out.shape == (2, 8)
+    assert not dht.is_alive  # context exit shuts everything down
+
+
 def test_checkpoints_roundtrip(tmp_path):
     from hivemind_tpu.moe.server.checkpoints import load_experts, store_experts
 
