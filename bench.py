@@ -36,22 +36,53 @@ def peak_flops(device) -> float:
     return 197e12  # default: v5e-class
 
 
-def _tpu_reachable(timeout: float = 90.0) -> bool:
+def _tpu_reachable(attempts: int = 3, timeout: float = 150.0) -> bool:
     """Probe TPU initialization in a SUBPROCESS: if the accelerator tunnel is wedged,
     jax.devices() hangs forever and would take the whole benchmark (and its driver)
-    with it. A hung probe is killed; the bench then falls back to CPU."""
+    with it. A hung probe is killed and retried with backoff (a busy tunnel often
+    recovers); only after all attempts fail does the bench fall back to CPU — and
+    then it says so loudly in the output instead of grading the CPU number."""
     import subprocess
     import sys
 
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(10.0 * attempt)
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; assert jax.devices()[0].platform != 'cpu'"],
+                timeout=timeout,
+                capture_output=True,
+            )
+            if probe.returncode == 0:
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+    return False
+
+
+def _averaging_gbps(timeout: float = 420.0):
+    """Second driver metric: butterfly all-reduce GB/s/peer (CPU/network-bound, does
+    not need the TPU). Run in a subprocess so a swarm hang can't take down the bench."""
+    import os
+    import subprocess
+    import sys
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmarks", "benchmark_averaging.py")
     try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; assert jax.devices()[0].platform != 'cpu'"],
-            timeout=timeout,
-            capture_output=True,
+        run = subprocess.run(
+            [sys.executable, script, "--num_peers", "4", "--target_group_size", "4",
+             "--num_rounds", "3", "--num_params", "4000000"],
+            timeout=timeout, capture_output=True, text=True,
         )
-        return probe.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+        for line in run.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+    except (subprocess.TimeoutExpired, json.JSONDecodeError, OSError):
+        pass
+    return None
 
 
 def main() -> None:
@@ -92,24 +123,32 @@ def main() -> None:
 
     tokens = batch_size * seq_len * num_steps
     tokens_per_sec = tokens / elapsed
-    mfu = tokens_per_sec * flops_per_token(config, seq_len) / peak_flops(device)
-    print(
-        json.dumps(
-            {
-                "metric": "albert_base_mlm_tokens_per_sec_per_chip",
-                "value": round(tokens_per_sec, 1),
-                "unit": "tokens/s",
-                "vs_baseline": round(mfu / 0.35, 4),
-                "extra": {
-                    "mfu": round(mfu, 4),
-                    "device": str(getattr(device, "device_kind", device.platform)),
-                    "batch_size": batch_size,
-                    "seq_len": seq_len,
-                    "final_loss": round(float(loss), 4),
-                },
-            }
-        )
-    )
+    averaging = _averaging_gbps()
+
+    result = {
+        "metric": "albert_base_mlm_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "extra": {
+            "device": str(getattr(device, "device_kind", device.platform)),
+            "batch_size": batch_size,
+            "seq_len": seq_len,
+            "final_loss": round(float(loss), 4),
+            "averaging_gbps_per_peer": (averaging or {}).get("value"),
+            "averaging_extra": (averaging or {}).get("extra"),
+        },
+    }
+    if on_tpu:
+        mfu = tokens_per_sec * flops_per_token(config, seq_len) / peak_flops(device)
+        result["vs_baseline"] = round(mfu / 0.35, 4)
+        result["extra"]["mfu"] = round(mfu, 4)
+    else:
+        # TPU unreachable after retries: refuse to grade a CPU number against a TPU
+        # baseline (round-1 lesson: a silent fallback reads as a 2000x regression).
+        result["tpu_unavailable"] = True
+        result["fallback"] = "cpu"
+        result["vs_baseline"] = 0.0
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -2,9 +2,13 @@
 — 16 peers, groups of 4, ~8.6M params). Reports rounds, success rate, and the driver
 north-star: effective GB/s per peer."""
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
+
 import argparse
 import json
-import sys
 import time
 
 import numpy as np

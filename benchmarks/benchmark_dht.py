@@ -1,6 +1,11 @@
 """DHT store/get benchmark (parity: reference benchmarks/benchmark_dht.py — baselines
 store 14.9ms/key, get 6.6ms/key at 1024 peers)."""
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
+
 import argparse
 import json
 import time

@@ -1,6 +1,11 @@
 """Collaborative optimizer harness (parity: reference benchmarks/benchmark_optimizer.py
 — MLP peers, target_batch_size epochs, convergence check)."""
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
+
 import argparse
 import json
 import threading

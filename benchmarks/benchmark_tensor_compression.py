@@ -1,6 +1,11 @@
 """Per-codec wall time on a 10M-element tensor (parity: reference
 benchmarks/benchmark_tensor_compression.py)."""
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
+
 import json
 import time
 

@@ -1,6 +1,11 @@
 """MoE server throughput (parity: reference benchmarks/benchmark_throughput.py —
 baselines 28,581 samples/s fwd+bwd, 97,604 fwd-only on a GTX 1080 Ti)."""
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
+
 import argparse
 import json
 import threading

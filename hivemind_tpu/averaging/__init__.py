@@ -2,6 +2,7 @@ from hivemind_tpu.averaging.allreduce import AllReduceRunner, AveragingMode
 from hivemind_tpu.averaging.averager import DecentralizedAverager
 from hivemind_tpu.averaging.control import AveragingStage, StepControl
 from hivemind_tpu.averaging.group_info import GroupInfo
+from hivemind_tpu.averaging.ici import MeshAverager
 from hivemind_tpu.averaging.key_manager import GroupKeyManager
 from hivemind_tpu.averaging.load_balancing import load_balance_peers
 from hivemind_tpu.averaging.matchmaking import Matchmaking, MatchmakingException

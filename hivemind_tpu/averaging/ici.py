@@ -1,0 +1,111 @@
+"""MeshAverager: a DecentralizedAverager whose averaged state lives SHARDED on a
+jax device mesh — the bridge between the swarm (internet) tier and the ICI tier of
+the two-tier communication backend (SURVEY §5).
+
+One mesh = one logical swarm peer. Per round:
+
+1. ``_pre_allreduce`` — the mesh-resident tree is staged to the host mirrors:
+   an optional on-device ``pmean`` (ICI psum under shard_map) collapses per-replica
+   values, then an XLA all-gather assembles each leaf once on the host. This replaces
+   the reference's host-side part accumulation (hivemind/averaging/partition.py:242-260)
+   with XLA collectives for everything inside the peer.
+2. The inherited butterfly all-reduce averages the host mirrors across swarm peers
+   over the network, exactly as for host-resident averagers.
+3. ``_post_allreduce`` — the averaged mirrors are scattered back onto the mesh with
+   the original shardings (each device receives only its shard).
+
+The device tree is any pytree of jax Arrays (params, grads, opt state). With
+``local_reduce_axis`` set, every leaf carries a leading per-replica dimension sharded
+over that mesh axis (the jax encoding of "each data-parallel replica holds its own
+copy"); the swarm contribution is the ICI mean and, post-round, every replica adopts
+the swarm average."""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Optional
+
+import jax
+
+from hivemind_tpu.averaging.averager import DecentralizedAverager
+from hivemind_tpu.dht import DHT
+from hivemind_tpu.parallel.ici import MeshTensorBridge
+from hivemind_tpu.utils.asyncio_utils import enter_asynchronously
+from hivemind_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class MeshAverager(DecentralizedAverager):
+    """See module docstring.
+
+    :param device_tree: pytree of (possibly sharded) jax Arrays averaged with the swarm
+    :param mesh: the jax Mesh this peer's state is sharded over
+    :param local_reduce_axis: if set, leaves are per-replica stacks over this mesh
+        axis; the peer's swarm contribution is their on-device mean (ICI psum)
+    """
+
+    def __init__(
+        self,
+        device_tree: Any,
+        mesh,
+        dht: DHT,
+        *,
+        local_reduce_axis: Optional[str] = None,
+        **kwargs,
+    ):
+        self.bridge = MeshTensorBridge(mesh)
+        self.local_reduce_axis = local_reduce_axis
+        self._device_tree = device_tree
+        self._tree_lock = threading.Lock()
+        host_tensors = self.bridge.gather_to_host(self._reduced_tree(device_tree))
+        super().__init__(host_tensors, dht, **kwargs)
+
+    # ---------------------------------------------------------------- device tree
+
+    def _reduced_tree(self, tree: Any) -> Any:
+        if self.local_reduce_axis is not None:
+            return self.bridge.mesh_mean(tree, self.local_reduce_axis)
+        return tree
+
+    @property
+    def device_tree(self) -> Any:
+        with self._tree_lock:
+            return self._device_tree
+
+    @device_tree.setter
+    def device_tree(self, tree: Any) -> None:
+        with self._tree_lock:
+            self._device_tree = tree
+
+    # ---------------------------------------------------------------- round hooks
+
+    def _stage_to_host(self) -> None:
+        """Blocking half of _pre_allreduce (runs in the executor): ICI reduce +
+        all-gather, then overwrite the host mirrors in place."""
+        with self._tree_lock:
+            tree = self._device_tree
+        fresh = self.bridge.gather_to_host(self._reduced_tree(tree))
+        with self.lock_averaged_tensors:
+            assert len(fresh) == len(self._averaged_tensors)
+            for mirror, value in zip(self._averaged_tensors, fresh):
+                mirror[...] = value.reshape(mirror.shape)
+
+    def _scatter_to_mesh(self) -> None:
+        """Blocking half of _post_allreduce: push averaged mirrors back as shards."""
+        with self.lock_averaged_tensors:
+            averaged = [t.copy() for t in self._averaged_tensors]
+        with self._tree_lock:
+            if self.local_reduce_axis is not None:
+                self._device_tree = self.bridge.broadcast_scatter_from_host(
+                    self._device_tree, averaged, self.local_reduce_axis
+                )
+            else:
+                self._device_tree = self.bridge.scatter_from_host(self._device_tree, averaged)
+
+    async def _pre_allreduce(self) -> None:
+        await asyncio.get_event_loop().run_in_executor(None, self._stage_to_host)
+
+    async def _post_allreduce(self) -> None:
+        await asyncio.get_event_loop().run_in_executor(None, self._scatter_to_mesh)

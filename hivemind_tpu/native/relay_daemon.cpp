@@ -36,6 +36,14 @@
 static constexpr size_t MAX_FRAME = 1 << 20;       // control frames only
 static constexpr size_t SPLICE_BUF = 1 << 16;      // per-direction pipe buffer
 static constexpr int PENDING_DIAL_TTL_MS = 30000;  // unmatched dials expire
+// Backpressure water marks. epoll here is level-triggered, so merely breaking out
+// of the read loop is NOT backpressure (the next epoll_wait re-fires EPOLLIN and
+// reads another 64 KiB): above HIGH_WATER the reading fd DROPS EPOLLIN interest and
+// is re-armed from on_writable once the partner drains below LOW_WATER — bounding
+// each direction at HIGH_WATER + one read (~576 KiB).
+static constexpr size_t HIGH_WATER = 8 * SPLICE_BUF;
+static constexpr size_t LOW_WATER = 2 * SPLICE_BUF;
+static constexpr int FLUSH_TTL_MS = 60000;  // closing_after_flush conns expire
 
 static double now_ms() {
   using namespace std::chrono;
@@ -54,6 +62,8 @@ struct Conn {
   int peer_fd = -1;         // spliced counterpart
   double created_ms = 0;
   bool want_write = false;
+  bool read_paused = false;  // EPOLLIN interest dropped (partner over HIGH_WATER)
+  bool closing_after_flush = false;  // partner gone: close once outbuf drains
 };
 
 static int g_epoll = -1;
@@ -68,7 +78,7 @@ static void set_nonblock(int fd) {
 
 static void update_events(Conn* c) {
   epoll_event ev{};
-  ev.events = EPOLLIN | (c->want_write ? EPOLLOUT : 0);
+  ev.events = (c->read_paused ? 0 : EPOLLIN) | (c->want_write ? EPOLLOUT : 0);
   ev.data.fd = c->fd;
   epoll_ctl(g_epoll, EPOLL_CTL_MOD, c->fd, &ev);
 }
@@ -110,16 +120,37 @@ static void close_conn(int fd) {
   if (partner >= 0) {
     auto pit = g_conns.find(partner);
     if (pit != g_conns.end()) {
-      pit->second->peer_fd = -1;
-      close_conn(partner);  // pipe is bidirectional: one side gone, tear down both
+      Conn* p = pit->second;
+      p->peer_fd = -1;
+      if (p->outbuf.empty()) {
+        close_conn(partner);  // pipe is bidirectional: one side gone, tear down both
+      } else {
+        // in-flight bytes the peer already sent must not be discarded: stop
+        // reading, flush the tail, then close from on_writable (the periodic
+        // sweep reaps flushers whose receiver never drains)
+        p->closing_after_flush = true;
+        p->read_paused = true;
+        p->created_ms = now_ms();
+        update_events(p);
+      }
     }
   }
+}
+
+static void enable_keepalive(int fd) {
+  int ka = 1, idle = 30, intvl = 10, cnt = 3;
+  setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &ka, sizeof(ka));
+  setsockopt(fd, IPPROTO_TCP, TCP_KEEPIDLE, &idle, sizeof(idle));
+  setsockopt(fd, IPPROTO_TCP, TCP_KEEPINTVL, &intvl, sizeof(intvl));
+  setsockopt(fd, IPPROTO_TCP, TCP_KEEPCNT, &cnt, sizeof(cnt));
 }
 
 static void splice_pair(Conn* a, Conn* b) {
   a->peer_fd = b->fd;
   b->peer_fd = a->fd;
   a->state = b->state = ConnState::Spliced;
+  enable_keepalive(a->fd);
+  enable_keepalive(b->fd);
   const char ok[] = {0, 0, 0, 1, 'O'};
   queue_write(a, ok, 5);
   queue_write(b, ok, 5);
@@ -132,12 +163,23 @@ static void handle_control_frame(Conn* c, const std::string& payload) {
   if (payload.empty()) { close_conn(c->fd); return; }
   char kind = payload[0];
   if (kind == 'R') {
-    c->peer_id = payload.substr(1);
-    if (c->peer_id.empty()) { close_conn(c->fd); return; }
-    auto old = g_control.find(c->peer_id);
-    if (old != g_control.end() && old->second != c->fd) close_conn(old->second);
+    std::string peer_id = payload.substr(1);
+    if (peer_id.empty()) { close_conn(c->fd); return; }
+    // First registration wins: a later REGISTER for the same peer_id is REFUSED
+    // while the original control line is alive, so an attacker cannot evict a
+    // registered peer and capture its INCOMING notifications. (Proof-of-identity
+    // via Ed25519 challenge would be stronger, but this image has no crypto
+    // library for the daemon; dead lines are reaped by TCP keepalive + EPOLLHUP,
+    // after which the legitimate peer can re-register.)
+    auto old = g_control.find(peer_id);
+    if (old != g_control.end() && old->second != c->fd) {
+      queue_frame(c, "E");
+      return;
+    }
+    c->peer_id = peer_id;
     g_control[c->peer_id] = c->fd;
     c->state = ConnState::Control;
+    enable_keepalive(c->fd);
     queue_frame(c, "O");
   } else if (kind == 'D' && payload.size() > 17) {
     std::string token = payload.substr(1, 16);
@@ -175,8 +217,12 @@ static void on_readable(Conn* c) {
       auto pit = g_conns.find(c->peer_fd);
       if (pit == g_conns.end()) { close_conn(c->fd); return; }
       queue_write(pit->second, buf, n);
-      // backpressure: stop reading while the partner's buffer is large
-      if (pit->second->outbuf.size() > 8 * SPLICE_BUF) break;
+      if (pit->second->outbuf.size() > HIGH_WATER) {
+        // real backpressure: drop EPOLLIN interest until the partner drains
+        c->read_paused = true;
+        update_events(c);
+        break;
+      }
     } else {
       c->inbuf.append(buf, n);
       while (c->state != ConnState::Spliced && c->inbuf.size() >= 4) {
@@ -192,17 +238,29 @@ static void on_readable(Conn* c) {
   }
 }
 
+static void maybe_resume_partner(Conn* c) {
+  // our queue drained below LOW_WATER: re-arm the peer that was paused on us
+  if (c->outbuf.size() >= LOW_WATER || c->peer_fd < 0) return;
+  auto pit = g_conns.find(c->peer_fd);
+  if (pit != g_conns.end() && pit->second->read_paused) {
+    pit->second->read_paused = false;
+    update_events(pit->second);
+  }
+}
+
 static void on_writable(Conn* c) {
   while (!c->outbuf.empty()) {
     ssize_t n = write(c->fd, c->outbuf.data(), c->outbuf.size());
     if (n < 0) {
-      if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) { maybe_resume_partner(c); return; }
       close_conn(c->fd); return;
     }
     c->outbuf.erase(0, n);
   }
+  if (c->closing_after_flush) { close_conn(c->fd); return; }
   c->want_write = false;
   update_events(c);
+  maybe_resume_partner(c);
 }
 
 int main(int argc, char** argv) {
@@ -261,12 +319,16 @@ int main(int argc, char** argv) {
       if (g_conns.find(fd) == g_conns.end()) continue;
       if (events[i].events & EPOLLOUT) on_writable(it->second);
     }
-    if (now_ms() - last_sweep > 5000) {  // expire unmatched dials
+    if (now_ms() - last_sweep > 5000) {  // expire unmatched dials + stuck flushers
       last_sweep = now_ms();
       std::vector<int> expired;
       for (auto& [token, fd] : g_pending_dials) {
         auto it = g_conns.find(fd);
         if (it == g_conns.end() || now_ms() - it->second->created_ms > PENDING_DIAL_TTL_MS)
+          expired.push_back(fd);
+      }
+      for (auto& [fd, conn] : g_conns) {
+        if (conn->closing_after_flush && now_ms() - conn->created_ms > FLUSH_TTL_MS)
           expired.push_back(fd);
       }
       for (int fd : expired) close_conn(fd);

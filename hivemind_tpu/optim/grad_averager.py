@@ -85,21 +85,28 @@ class GradientAverager(DecentralizedAverager):
         weight: Optional[float] = None,
         control: Optional[StepControl] = None,
         reset_accumulators: bool = True,
+        load_accumulators: bool = True,
         wait: bool = True,
         timeout: Optional[float] = None,
         **kwargs,
     ):
         """Average the accumulated gradients with the group; fills the shared
-        averaged-gradient buffers (reference grad_averager.py:163-201)."""
+        averaged-gradient buffers (reference grad_averager.py:163-201).
+
+        :param load_accumulators: stage the live accumulators into the shared buffers
+            now. Delayed (DPU) updates stage them at schedule time instead and pass
+            False, so gradients of the NEXT epoch accumulating concurrently cannot
+            leak into the in-flight round."""
         if control is None:
             control = super().step(weight=weight, wait=False, require_trigger=True, timeout=timeout, **kwargs)
         elif weight is not None:
             control.weight = weight
-        self.load_accumulators_into_averager_()
-        if control.weight == 1.0 and self.local_samples_accumulated > 0:
-            control.weight = self.local_samples_accumulated
-        if reset_accumulators:
-            self.reset_accumulated_grads_()
+        if load_accumulators:
+            self.load_accumulators_into_averager_()
+            if control.weight == 1.0 and self.local_samples_accumulated > 0:
+                control.weight = self.local_samples_accumulated
+            if reset_accumulators:
+                self.reset_accumulated_grads_()
         control.allow_allreduce()
         return control.result(timeout) if wait else control
 

@@ -32,6 +32,10 @@ class TrainingStateAverager(DecentralizedAverager):
     :param average_opt_statistics: also average float optimizer-state leaves (e.g.
         Adam's mu/nu) so joining peers inherit momentum
     :param extra_tensors: additional arrays averaged and shared with state downloads
+    :param delta_rule_averaging: apply each averaging round's result as a DELTA
+        (average − pre-round snapshot) onto the CURRENT state instead of overwriting
+        it, so optimizer steps taken concurrently with the round are not clobbered —
+        required for delayed/local updates (reference state_averager.py:73-74)
     """
 
     def __init__(
@@ -43,11 +47,15 @@ class TrainingStateAverager(DecentralizedAverager):
         prefix: str,
         average_opt_statistics: bool = True,
         extra_tensors: Sequence = (),
+        delta_rule_averaging: bool = False,
+        count_equals_epoch: bool = True,
         **kwargs,
     ):
         import jax
 
         self.optax_optimizer = optimizer
+        self.delta_rule_averaging = delta_rule_averaging
+        self.count_equals_epoch = count_equals_epoch
         params_flat, self._params_treedef = jax.tree_util.tree_flatten(params)
         self._params_flat = [jax.numpy.asarray(p) for p in params_flat]
         self.opt_state = optimizer.init(jax.tree_util.tree_unflatten(self._params_treedef, self._params_flat))
@@ -150,10 +158,14 @@ class TrainingStateAverager(DecentralizedAverager):
 
     def do_averaging_round(self, timeout: Optional[float] = None, **kwargs) -> bool:
         """Stage state to host, average with the group, load it back. Returns True on
-        success (reference state_averager averaging_round path)."""
-        host_tensors = self._host_state_tensors()
+        success (reference state_averager averaging_round path).
+
+        With ``delta_rule_averaging``, the result lands as ``current + (average −
+        snapshot)``: local optimizer steps that ran while the round was in flight
+        survive (reference state_averager.py:73-74,595-612)."""
+        snapshot = self._host_state_tensors()
         with self.get_tensors() as tensors:
-            for tensor, fresh in zip(tensors, host_tensors):
+            for tensor, fresh in zip(tensors, snapshot):
                 np.copyto(tensor, fresh)
         try:
             result = self.step(timeout=timeout, wait=True, **kwargs)
@@ -163,8 +175,98 @@ class TrainingStateAverager(DecentralizedAverager):
         if result is None:
             return False
         with self.get_tensors() as tensors:
-            self._load_host_state_tensors([t.copy() for t in tensors])
+            averaged = [t.copy() for t in tensors]
+        if self.delta_rule_averaging:
+            current = self._host_state_tensors()
+            merged = [cur + (avg - snap) for cur, avg, snap in zip(current, averaged, snapshot)]
+            self._load_host_state_tensors(merged)
+        else:
+            self._load_host_state_tensors(averaged)
         return True
+
+    # ------------------------------------------------------------------ schedules
+
+    def replay_schedule_to_epoch(self, epoch: int) -> None:
+        """Fast-forward optax step counters to ``epoch`` so epoch-keyed schedules
+        (LR warmup/decay) resume at the right point after adopting a peer's params
+        (reference state_averager.py:700-704 replays scheduler.step() local_epoch
+        times; optax counters jump directly). Only scalar integer leaves whose field
+        is named ``count`` are touched — the optax convention for step counters.
+
+        Valid ONLY under the collaborative convention one optimizer step == one
+        epoch; local-updates peers take many steps per epoch, so their counters are
+        preserved (gated by ``count_equals_epoch``)."""
+        if not self.count_equals_epoch:
+            return
+        self._set_opt_counts([epoch])
+
+    @staticmethod
+    def _is_count_leaf(key_path, leaf) -> bool:
+        return bool(
+            key_path
+            and getattr(key_path[-1], "name", None) == "count"
+            and hasattr(leaf, "dtype")
+            and np.issubdtype(np.asarray(leaf).dtype, np.integer)
+            and np.asarray(leaf).ndim == 0
+        )
+
+    def _set_opt_counts(self, values: Sequence[int]) -> None:
+        """Overwrite the optax count leaves in flatten order; a single value is
+        broadcast to every counter."""
+        import jax
+        import jax.numpy as jnp
+
+        with self._state_lock:
+            flat, _ = jax.tree_util.tree_flatten_with_path(self.opt_state)
+            new_leaves, index = [], 0
+            for key_path, leaf in flat:
+                if self._is_count_leaf(key_path, leaf):
+                    value = values[index] if index < len(values) else values[-1]
+                    new_leaves.append(jnp.asarray(value, dtype=leaf.dtype))
+                    index += 1
+                else:
+                    new_leaves.append(leaf)
+            self.opt_state = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(self.opt_state), new_leaves
+            )
+
+    # ------------------------------------------------------------------ checkpointing
+
+    def state_dict(self) -> dict:
+        """Serializable snapshot: epoch + every averaged tensor (params, chosen opt
+        statistics, extras) — the user-level checkpoint the reference embeds the
+        epoch into (reference optimizer.py:719-727)."""
+        with self._state_lock:
+            tensors = self._host_state_tensors()
+        return {
+            "epoch": int(self.local_epoch),
+            "tensors": tensors,
+            # counters saved explicitly: local-updates peers take many optimizer
+            # steps per epoch, so counts cannot be reconstructed from the epoch
+            "opt_counts": self._get_opt_counts(),
+        }
+
+    def _get_opt_counts(self) -> List[int]:
+        import jax
+
+        return [
+            int(leaf)
+            for key_path, leaf in jax.tree_util.tree_flatten_with_path(self.opt_state)[0]
+            if self._is_count_leaf(key_path, leaf)
+        ]
+
+    def load_state_dict(self, state: dict) -> None:
+        expected = len(self._params_flat) + len(self._averaged_opt_indices) + len(self.extra_tensors)
+        tensors = state["tensors"]
+        if len(tensors) != expected:
+            raise ValueError(f"checkpoint has {len(tensors)} tensors, expected {expected}")
+        self._load_host_state_tensors([np.asarray(t, dtype=np.float32) for t in tensors])
+        self.local_epoch = int(state["epoch"])
+        counts = state.get("opt_counts")
+        if counts:
+            self._set_opt_counts(list(counts))
+        else:
+            self.replay_schedule_to_epoch(self.local_epoch)
 
     # ------------------------------------------------------------------ state sharing
 
@@ -186,5 +288,8 @@ class TrainingStateAverager(DecentralizedAverager):
         self._load_host_state_tensors(tensors)
         if isinstance(metadata, dict) and "epoch" in metadata:
             self.local_epoch = max(self.local_epoch, int(metadata["epoch"]))
+        # int step counters are not averaged tensors: fast-forward them so LR
+        # schedules resume at the adopted epoch rather than restarting warmup
+        self.replay_schedule_to_epoch(self.local_epoch)
         logger.info(f"adopted peer state at epoch {self.local_epoch}")
         return True

@@ -230,6 +230,18 @@ class MuxConnection:
 
     async def _dispatch(self, stream_id: int, flags: Flags, payload: bytes) -> None:
         if flags & Flags.OPEN:
+            # a remote OPEN must use the REMOTE side's id parity and a fresh id: a
+            # misbehaving peer reusing a local-parity or existing id would silently
+            # replace a live stream in _streams, misrouting its responses and
+            # orphaning its credit accounting
+            if stream_id % 2 == self._next_stream_id % 2 or stream_id in self._streams:
+                logger.warning(
+                    f"connection to {self.peer_id}: rejecting OPEN with "
+                    f"{'local-parity' if stream_id % 2 == self._next_stream_id % 2 else 'duplicate'} "
+                    f"stream id {stream_id}"
+                )
+                await self.send_frame(stream_id, Flags.RESET, b"")
+                return
             handler_name = payload.decode("utf-8", errors="replace")
             stream = MuxStream(self, stream_id, handler_name)
             self._streams[stream_id] = stream

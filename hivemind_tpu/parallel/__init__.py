@@ -1,3 +1,4 @@
+from hivemind_tpu.parallel.ici import MeshTensorBridge
 from hivemind_tpu.parallel.mesh import (
     batch_sharding,
     make_mesh,

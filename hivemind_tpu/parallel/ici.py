@@ -1,0 +1,140 @@
+"""ICI tier of the two-tier communication backend (SURVEY §5): one device mesh is ONE
+logical swarm peer.
+
+The reference's hot loop reduces tensor parts with in-place host arithmetic on a single
+machine (reference hivemind/averaging/partition.py:242-260, ``add_``/``div_``). On TPU
+the intra-peer half of that reduction belongs ON the mesh: per-replica values are
+reduced with ``jax.lax.pmean`` (an ICI psum) under ``shard_map``, shards are assembled
+with XLA all-gathers by resharding to a replicated layout, and the host only ever
+stages the single already-reduced copy at the network boundary. The swarm (internet)
+tier then averages those host copies across peers; the result is scattered back onto
+the mesh as one ``device_put`` per leaf.
+
+Two entry points:
+
+- :class:`MeshTensorBridge` — the device↔host boundary: ``mesh_mean`` (on-device psum
+  reduction over one mesh axis), ``gather_to_host`` (ICI all-gather → one fp32 host
+  copy per leaf), ``scatter_from_host`` (host → original shardings).
+- :class:`hivemind_tpu.averaging.ici.MeshAverager` — a DecentralizedAverager whose
+  local tensors live sharded on a mesh and cross the host boundary only per round.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # top-level since jax 0.8; experimental path for older versions
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
+
+
+def _leaf_spec(leaf) -> P:
+    sharding = getattr(leaf, "sharding", None)
+    if isinstance(sharding, NamedSharding):
+        return sharding.spec
+    return P()
+
+
+class MeshTensorBridge:
+    """Device↔host staging for one mesh-resident logical peer. jit-compiled transfer
+    functions are cached per (treedef, shapes/dtypes/specs) signature so steady-state
+    rounds pay zero retracing."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self._fn_cache: dict = {}
+
+    # ---------------------------------------------------------------- on-device reduce
+
+    def mesh_mean(self, stacked_tree: Any, axis: str = "dp") -> Any:
+        """Reduce per-replica values across one mesh axis WITHOUT leaving the device.
+
+        Each leaf must have leading dimension ``mesh.shape[axis]`` sharded over
+        ``axis`` (the jax representation of "every replica holds its own copy").
+        Returns the tree with the leading axis reduced away — the mean runs as a
+        ``psum`` over ICI under ``shard_map``, the TPU-native equivalent of the
+        reference's host-side accumulate/divide loop (partition.py:242-260)."""
+        leaves, treedef = jax.tree_util.tree_flatten(stacked_tree)
+        axis_size = self.mesh.shape[axis]
+        in_specs, out_specs = [], []
+        for leaf in leaves:
+            if leaf.ndim < 1 or leaf.shape[0] != axis_size:
+                raise ValueError(
+                    f"mesh_mean leaf {leaf.shape} lacks leading {axis}-dim of {axis_size}"
+                )
+            spec = _leaf_spec(leaf)
+            rest = tuple(spec)[1:] if len(spec) else ()
+            in_specs.append(P(axis, *rest))
+            out_specs.append(P(*rest))
+        in_specs = jax.tree_util.tree_unflatten(treedef, in_specs)
+        out_specs = jax.tree_util.tree_unflatten(treedef, out_specs)
+
+        key = ("mean", axis, treedef, tuple((l.shape, str(l.dtype), str(_leaf_spec(l))) for l in leaves))
+        fn = self._fn_cache.get(key)
+        if fn is None:
+
+            def _reduce(tree):
+                return jax.tree_util.tree_map(
+                    lambda x: jax.lax.pmean(jnp.squeeze(x, axis=0), axis), tree
+                )
+
+            fn = jax.jit(
+                shard_map(_reduce, mesh=self.mesh, in_specs=(in_specs,), out_specs=out_specs)
+            )
+            self._fn_cache[key] = fn
+        return fn(stacked_tree)
+
+    # ---------------------------------------------------------------- host boundary
+
+    def gather_to_host(self, tree: Any) -> List[np.ndarray]:
+        """Assemble full fp32 copies of every leaf on the host: XLA inserts the
+        all-gathers over ICI when resharding to a replicated layout; exactly one host
+        transfer happens per leaf, of the final reduced bytes."""
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        key = ("gather", treedef, tuple((l.shape, str(l.dtype), str(_leaf_spec(l))) for l in leaves))
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            replicated = NamedSharding(self.mesh, P())
+            fn = jax.jit(
+                lambda ls: [x.astype(jnp.float32) for x in ls],
+                out_shardings=[replicated] * len(leaves),
+            )
+            self._fn_cache[key] = fn
+        return [np.asarray(x) for x in fn(leaves)]
+
+    def scatter_from_host(self, like_tree: Any, host_tensors: Sequence[np.ndarray]) -> Any:
+        """Push host values back onto the mesh with ``like_tree``'s shardings and
+        dtypes (one device_put per leaf; each device receives only its shard)."""
+        leaves, treedef = jax.tree_util.tree_flatten(like_tree)
+        assert len(leaves) == len(host_tensors), (len(leaves), len(host_tensors))
+        new_leaves = []
+        for leaf, host in zip(leaves, host_tensors):
+            value = np.asarray(host, dtype=leaf.dtype).reshape(leaf.shape)
+            sharding = getattr(leaf, "sharding", None)
+            if isinstance(sharding, NamedSharding):
+                new_leaves.append(jax.device_put(value, sharding))
+            else:
+                new_leaves.append(jnp.asarray(value))
+        return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+    def broadcast_scatter_from_host(
+        self, like_stacked_tree: Any, host_tensors: Sequence[np.ndarray], axis: str = "dp"
+    ) -> Any:
+        """Scatter reduced host values back to a per-replica stacked tree: every
+        replica along ``axis`` adopts the (swarm-averaged) value."""
+        leaves, treedef = jax.tree_util.tree_flatten(like_stacked_tree)
+        axis_size = self.mesh.shape[axis]
+        stacked = [
+            np.broadcast_to(
+                np.asarray(h, dtype=l.dtype).reshape(l.shape[1:]), (axis_size,) + tuple(l.shape[1:])
+            )
+            for l, h in zip(leaves, host_tensors)
+        ]
+        return self.scatter_from_host(like_stacked_tree, stacked)

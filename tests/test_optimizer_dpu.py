@@ -1,0 +1,231 @@
+"""Round-2 optimizer features: Delayed Parameter Updates (background epoch
+transitions), delta-rule state averaging, aux-peer schema bootstrap, user-level
+checkpointing with schedule replay, and the one-epoch-grace reload rule
+(VERDICT r1 items 4, 5, 7, 8)."""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hivemind_tpu.dht import DHT
+from hivemind_tpu.optim import GradientAverager, Optimizer, TrainingStateAverager
+
+
+def launch_dht_swarm(n: int):
+    first = DHT(start=True)
+    maddrs = [str(m) for m in first.get_visible_maddrs()]
+    return [first] + [DHT(initial_peers=maddrs, start=True) for _ in range(n - 1)]
+
+
+def _toy_problem(seed=0):
+    rng = np.random.RandomState(seed)
+    true_w = rng.randn(8).astype(np.float32)
+    features = rng.randn(256, 8).astype(np.float32)
+    targets = features @ true_w
+
+    @jax.jit
+    def loss_and_grad(params, x, y):
+        def loss_fn(p):
+            return jnp.mean((x @ p["w"] - y) ** 2)
+
+        return jax.value_and_grad(loss_fn)(params)
+
+    return features, targets, loss_and_grad
+
+
+def test_dpu_overlapped_convergence():
+    """delay_optimizer_step=True: step() must return while an epoch transition is
+    still in flight at least once, training must keep going, and the loss must drop."""
+    features, targets, loss_and_grad = _toy_problem()
+    dhts = launch_dht_swarm(2)
+    results, errors = {}, []
+    overlap_observed = threading.Event()
+
+    def run_peer(index: int, dht: DHT):
+        try:
+            params = {"w": jnp.zeros(8, jnp.float32)}
+            opt = Optimizer(
+                dht=dht, run_id="dpu_test", target_batch_size=64,
+                params=params, optimizer=optax.sgd(0.3),
+                batch_size_per_step=16, matchmaking_time=1.5, averaging_timeout=30,
+                average_state_every=1, target_group_size=2,
+                delay_optimizer_step=True, delta_rule_averaging=True,
+                tracker_opts=dict(min_refresh_period=0.3, default_refresh_period=0.5),
+            )
+            rng_local = np.random.RandomState(index)
+            first_loss = last_loss = None
+            for _ in range(80):
+                if opt.local_epoch >= 4:
+                    break
+                idx = rng_local.choice(len(features), 16)
+                loss, grads = loss_and_grad(opt.params, features[idx], targets[idx])
+                first_loss = first_loss if first_loss is not None else float(loss)
+                last_loss = float(loss)
+                opt.step(grads)
+                if opt._pending_update is not None and not opt._pending_update.done():
+                    overlap_observed.set()  # training continued during an in-flight round
+                time.sleep(0.25)
+            results[index] = (first_loss, last_loss, opt.local_epoch)
+            opt.shutdown()
+        except Exception as e:
+            import traceback
+
+            errors.append((index, e, traceback.format_exc()))
+
+    threads = [threading.Thread(target=run_peer, args=(i, d)) for i, d in enumerate(dhts)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=240)
+    try:
+        assert not errors, f"peer failures: {errors}"
+        assert len(results) == 2
+        assert overlap_observed.is_set(), "no step() returned during an in-flight transition"
+        for index, (first_loss, last_loss, epoch) in results.items():
+            assert epoch >= 2, f"peer {index} stuck at epoch {epoch}"
+            assert last_loss < first_loss / 5, (
+                f"peer {index}: loss {first_loss:.4f} -> {last_loss:.4f} did not converge"
+            )
+    finally:
+        for dht in dhts:
+            dht.shutdown()
+
+
+def test_delta_rule_preserves_concurrent_steps():
+    """Deterministic delta-rule check: an optimizer step applied WHILE the averaging
+    round is in flight must survive (result = current + average − snapshot)."""
+    dht = DHT(start=True)
+    try:
+        params = {"w": jnp.full((4,), 10.0, jnp.float32)}
+        averager = TrainingStateAverager(
+            dht=dht, optimizer=optax.sgd(1.0), params=params, prefix="deltarule",
+            start=True, delta_rule_averaging=True, average_opt_statistics=False,
+        )
+
+        fake_average = np.full((4,), 8.0, np.float32)  # pretend the group averaged to 8
+
+        def fake_step(self_unused=None, timeout=None, wait=True, **kwargs):
+            # concurrent local update lands mid-round: params 10 -> 6 (sgd lr=1, grad=4)
+            averager.apply_optimizer_step({"w": jnp.full((4,), 4.0, jnp.float32)})
+            with averager.get_tensors() as tensors:
+                tensors[0][...] = fake_average
+            return {}
+
+        averager.step = fake_step
+        assert averager.do_averaging_round(timeout=5)
+        # delta rule: 6 + (8 − 10) = 4; plain overwrite would clobber the local step to 8
+        np.testing.assert_allclose(np.asarray(averager.params["w"]), 4.0, atol=1e-6)
+        averager.shutdown()
+    finally:
+        dht.shutdown()
+
+
+def test_aux_peer_schema_bootstrap():
+    """An auxiliary peer with ZERO model knowledge learns the gradient schema from
+    the swarm (VERDICT r1 item 7)."""
+    dhts = launch_dht_swarm(2)
+    worker = aux = None
+    try:
+        params = {"w": jnp.zeros((6, 3), jnp.float32), "b": jnp.zeros(3, jnp.float32)}
+        worker = Optimizer(
+            dht=dhts[0], run_id="auxboot", target_batch_size=64,
+            params=params, optimizer=optax.sgd(0.1), batch_size_per_step=16,
+            matchmaking_time=1.0,
+        )
+        aux = Optimizer(
+            dht=dhts[1], run_id="auxboot", target_batch_size=64,
+            auxiliary=True, matchmaking_time=1.0, load_state_timeout=60,
+        )
+        assert aux.grad_averager is not None
+        with aux.grad_averager.get_tensors() as tensors:
+            shapes = sorted(tuple(t.shape) for t in tensors)
+        assert shapes == sorted([(6, 3), (3,)])
+        # matching schema hash means the aux peer can actually join groups
+        assert aux.grad_averager.schema_hash == worker.grad_averager.schema_hash
+    finally:
+        for opt in (aux, worker):
+            if opt is not None:
+                opt.shutdown()
+        for dht in dhts:
+            dht.shutdown()
+
+
+def test_state_dict_roundtrip_with_schedule_replay():
+    """Checkpoint embeds the epoch; restoring replays optax step counters so LR
+    schedules resume correctly (VERDICT r1 item 8)."""
+    dht = DHT(start=True)
+    try:
+        schedule = optax.linear_schedule(0.0, 1.0, transition_steps=10)
+        make_opt = lambda: optax.chain(optax.scale_by_adam(), optax.scale_by_schedule(schedule))
+        params = {"w": jnp.ones((5,), jnp.float32)}
+
+        source = Optimizer(
+            dht=dht, run_id="ckpt_src", target_batch_size=64,
+            params=params, optimizer=make_opt(), batch_size_per_step=16,
+        )
+        for _ in range(3):
+            source.state_averager.apply_optimizer_step({"w": jnp.full((5,), 0.1, jnp.float32)})
+        source.state_averager.local_epoch = 3
+        checkpoint = source.state_dict()
+        assert checkpoint["epoch"] == 3
+
+        restored = Optimizer(
+            dht=dht, run_id="ckpt_dst", target_batch_size=64,
+            params=params, optimizer=make_opt(), batch_size_per_step=16,
+        )
+        restored.load_state_dict(checkpoint)
+        assert restored.local_epoch == 3
+        for mine, theirs in zip(
+            restored.state_averager._host_state_tensors(),
+            source.state_averager._host_state_tensors(),
+        ):
+            np.testing.assert_allclose(mine, theirs, atol=1e-6)
+        # optax step counters were fast-forwarded to the epoch
+        counts = [
+            np.asarray(leaf)
+            for path, leaf in jax.tree_util.tree_flatten_with_path(restored.state_averager.opt_state)[0]
+            if path and getattr(path[-1], "name", None) == "count"
+        ]
+        assert counts and all(c == 3 for c in counts)
+        source.shutdown()
+        restored.shutdown()
+    finally:
+        dht.shutdown()
+
+
+def test_one_epoch_grace_reload_rule():
+    """DPU peers trailing by exactly one epoch must NOT redownload state; two or
+    more epochs behind (or non-DPU peers one behind) must."""
+    dht = DHT(start=True)
+    opt = None
+    try:
+        params = {"w": jnp.zeros((2,), jnp.float32)}
+        opt = Optimizer(
+            dht=dht, run_id="grace", target_batch_size=64,
+            params=params, optimizer=optax.sgd(0.1), delay_optimizer_step=True,
+        )
+        opt.tracker.shutdown()
+        opt.tracker = SimpleNamespace(global_epoch=1, shutdown=lambda: None)
+        assert opt.local_epoch == 0
+        assert not opt._should_load_state_from_peers()  # one behind: grace
+        opt.tracker.global_epoch = 2
+        assert opt._should_load_state_from_peers()  # two behind: reload
+        # an in-flight background transition suppresses reload entirely
+        opt._pending_update = SimpleNamespace(done=lambda: False)
+        assert not opt._should_load_state_from_peers()
+        opt._pending_update = None
+        # non-DPU peers keep the strict rule
+        opt.delay_optimizer_step = False
+        opt.tracker.global_epoch = 1
+        assert opt._should_load_state_from_peers()
+    finally:
+        if opt is not None:
+            opt.shutdown()
+        dht.shutdown()

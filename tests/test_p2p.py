@@ -260,3 +260,40 @@ async def test_servicer_namespaces():
         await stub_missing.rpc_square(test_pb2.TestRequest(number=3))
     await client.shutdown()
     await server.shutdown()
+
+
+async def test_mux_rejects_invalid_open_frames():
+    """OPEN frames with local-parity or already-used stream ids must be RESET, not
+    silently replace a live stream (ADVICE r1: stream hijack via id collision)."""
+    from hivemind_tpu.p2p.mux import Flags
+
+    server = await P2P.create()
+    client = await P2P.create()
+    try:
+        async def echo(request: test_pb2.TestRequest, context: P2PContext) -> test_pb2.TestResponse:
+            return test_pb2.TestResponse(number=request.number)
+
+        await server.add_protobuf_handler("echo", echo, test_pb2.TestRequest)
+        await client.connect(server.get_visible_maddrs()[0])
+        response = await client.call_protobuf_handler(
+            server.peer_id, "echo", test_pb2.TestRequest(number=7), test_pb2.TestResponse
+        )
+        assert response.number == 7
+
+        conn = client._connections[server.peer_id]
+        # client is the initiator: its local ids are odd. A remote OPEN with an odd
+        # id (wrong parity) must be rejected...
+        local_parity_id = conn._next_stream_id  # odd, unused
+        await conn._dispatch(local_parity_id, Flags.OPEN, b"echo")
+        assert local_parity_id not in conn._streams
+        # ...and so must an OPEN duplicating an id that is already live
+        stream = await conn.open_stream("echo")
+        before = conn._streams[stream.stream_id]
+        await conn._dispatch(stream.stream_id, Flags.OPEN, b"echo")
+        assert conn._streams[stream.stream_id] is before
+        # valid remote-parity OPEN still works
+        await conn._dispatch(1000, Flags.OPEN, b"echo")
+        assert 1000 in conn._streams
+    finally:
+        await client.shutdown()
+        await server.shutdown()
