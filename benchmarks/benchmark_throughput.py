@@ -54,7 +54,7 @@ def main():
             for b in range(args.batches_per_client):
                 x = rng.randn(args.batch_size, args.hidden_dim).astype(np.float32)
                 expert = experts[(index + b) % len(experts)]
-                out = expert.forward_np(x)
+                out = expert.forward_np(x)[0]
                 if args.backward:
                     expert.backward_np(x, np.ones_like(out))
                 processed[index] += args.batch_size

@@ -73,7 +73,8 @@ class Quantile8BitQuantization(_CodebookQuantization):
 
 class BlockwiseQuantization(CompressionBase):
     """Per-4096-block absmax int8 (reference quantization.py:130-201 via bitsandbytes;
-    here a jitted jax op — see ops/quantization.py for the deviation note).
+    here a fused Pallas kernel on TPU / fused-jnp on host — see
+    ops/pallas_quantization.py and ops/quantization.py for the deviation note).
     Wire format: [u32 n_blocks][u32 true_size][fp32 absmax per block][i8 codes]."""
 
     compression_type = CompressionType.BLOCKWISE_8BIT
@@ -83,7 +84,9 @@ class BlockwiseQuantization(CompressionBase):
         original_dtype = "bfloat16" if str(array.dtype) == "bfloat16" else array.dtype.name
         flat = np.ascontiguousarray(array, dtype=np.float32).reshape(-1)
         padded, true_size = pad_to_block(flat)
-        codes, absmax = blockwise_quantize(padded)
+        from hivemind_tpu.ops.pallas_quantization import blockwise_quantize_auto
+
+        codes, absmax = blockwise_quantize_auto(padded)
         codes, absmax = np.asarray(codes), np.asarray(absmax)
         buffer = (
             struct.pack("<II", absmax.size, true_size)
@@ -95,14 +98,14 @@ class BlockwiseQuantization(CompressionBase):
         )
 
     def extract(self, serialized: runtime_pb2.Tensor) -> np.ndarray:
-        from hivemind_tpu.ops.quantization import blockwise_dequantize
+        from hivemind_tpu.ops.pallas_quantization import blockwise_dequantize_auto
         from hivemind_tpu.utils.tensor_descr import numpy_dtype
 
         n_blocks, true_size = struct.unpack_from("<II", serialized.buffer)
         absmax = np.frombuffer(serialized.buffer, dtype=np.float32, count=n_blocks, offset=8)
         codes = np.frombuffer(serialized.buffer, dtype=np.int8, offset=8 + n_blocks * 4)
         codes = codes.reshape(n_blocks, -1)
-        restored = np.asarray(blockwise_dequantize(codes, absmax))[:true_size]
+        restored = np.asarray(blockwise_dequantize_auto(codes, absmax))[:true_size]
         return restored.astype(numpy_dtype(serialized.dtype or "float32")).reshape(tuple(serialized.size))
 
     def estimate_compression_ratio(self, info: CompressionInfo) -> float:

@@ -1,0 +1,243 @@
+"""Batched fault-tolerant scatter/gather to many experts (capability parity:
+reference hivemind/moe/client/moe.py:192-442, the ``_RemoteCallMany`` autograd
+Function).
+
+One ``jax.custom_vjp`` primitive covers the whole expert fan-out: the primal pass
+issues every expert's forward RPC CONCURRENTLY on the shared asyncio loop (a slow
+expert costs max(), not sum()) and returns stacked per-slot outputs plus an alive
+mask; the cotangent pass issues backward RPCs for the experts that answered.
+Per-sample guarantees mirror the reference:
+
+- ``k_min`` / ``backward_k_min``: each sample needs at least this many live expert
+  responses on forward/backward, else the call raises;
+- ``timeout_after_k_min``: once every sample has k_min responses, stragglers get at
+  most this many extra seconds before being abandoned (their slots stay masked);
+- ``forward_timeout`` / ``backward_timeout``: hard deadlines for each pass.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hivemind_tpu.moe.client.expert import RemoteExpert
+from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.loop import get_loop_runner
+from hivemind_tpu.utils.timed_storage import get_dht_time
+
+logger = get_logger(__name__)
+
+
+class RemoteCallMany:
+    """Callable: ``outputs [batch, k, d_out], alive [batch, k] = rcm(x)``.
+
+    :param experts_per_sample: for each sample, up to k experts (shorter rows are
+        padded internally; padded slots always report dead)
+    """
+
+    def __init__(
+        self,
+        experts_per_sample: Sequence[Sequence[RemoteExpert]],
+        *,
+        k_min: int = 1,
+        backward_k_min: int = 1,
+        forward_timeout: Optional[float] = None,
+        backward_timeout: Optional[float] = None,
+        timeout_after_k_min: Optional[float] = None,
+    ):
+        self.experts_per_sample = [list(row) for row in experts_per_sample]
+        self.batch_size = len(self.experts_per_sample)
+        self.k_max = max((len(row) for row in self.experts_per_sample), default=0)
+        assert self.k_max > 0, "every sample needs at least one expert"
+        self.k_min, self.backward_k_min = k_min, backward_k_min
+        self.forward_timeout, self.backward_timeout = forward_timeout, backward_timeout
+        self.timeout_after_k_min = timeout_after_k_min
+
+        # expert uid -> (expert, [(sample, slot), ...]): ONE batched RPC per expert
+        self.jobs: Dict[str, Tuple[RemoteExpert, List[Tuple[int, int]]]] = {}
+        for sample, row in enumerate(self.experts_per_sample):
+            for slot, expert in enumerate(row):
+                if expert is None:
+                    continue
+                self.jobs.setdefault(expert.uid, (expert, []))[1].append((sample, slot))
+
+    # ------------------------------------------------------------------ fan-out core
+
+    async def _fan_out(
+        self,
+        make_call,
+        need_per_sample: int,
+        timeout: Optional[float],
+        job_uids: Sequence[str],
+    ) -> Dict[str, List[np.ndarray]]:
+        """Run one RPC per expert concurrently; return {uid: tensors} for the ones
+        that answered in time. Applies the k_min / timeout_after_k_min policy."""
+        loop_tasks = {
+            asyncio.ensure_future(make_call(self.jobs[uid][0], uid)): uid for uid in job_uids
+        }
+        results: Dict[str, List[np.ndarray]] = {}
+        alive_count = [0] * self.batch_size
+        needed = [
+            min(need_per_sample, sum(e is not None for e in row)) or 1
+            for row in self.experts_per_sample
+        ]
+        hard_deadline = get_dht_time() + timeout if timeout is not None else None
+        soft_deadline = None  # set once every sample is satisfied
+
+        pending = set(loop_tasks)
+        try:
+            while pending:
+                now = get_dht_time()
+                wait_for = None
+                if hard_deadline is not None:
+                    wait_for = max(hard_deadline - now, 0.0)
+                if soft_deadline is not None:
+                    soft_left = max(soft_deadline - now, 0.0)
+                    wait_for = soft_left if wait_for is None else min(wait_for, soft_left)
+                if wait_for is not None and wait_for <= 0:
+                    break
+                done, pending = await asyncio.wait(
+                    pending, timeout=wait_for, return_when=asyncio.FIRST_COMPLETED
+                )
+                if not done:
+                    break  # deadline
+                for task in done:
+                    uid = loop_tasks[task]
+                    try:
+                        results[uid] = task.result()
+                        for sample, _slot in self.jobs[uid][1]:
+                            alive_count[sample] += 1
+                    except Exception as e:
+                        logger.warning(f"expert {uid} failed: {e!r}; masking it out")
+                if (
+                    soft_deadline is None
+                    and self.timeout_after_k_min is not None
+                    and all(count >= need for count, need in zip(alive_count, needed))
+                ):
+                    soft_deadline = get_dht_time() + self.timeout_after_k_min
+        finally:
+            for task in pending:
+                task.cancel()
+        return results
+
+    # ------------------------------------------------------------------ forward
+
+    def _forward_np(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        d_out = self._output_dim()
+        x = np.asarray(x, np.float32)
+
+        async def call_forward(expert: RemoteExpert, uid: str):
+            samples = [s for s, _ in self.jobs[uid][1]]
+            return await expert._call("forward", [x[samples]])
+
+        results = get_loop_runner().run_coroutine(
+            self._fan_out(call_forward, self.k_min, self.forward_timeout, list(self.jobs))
+        )
+
+        outputs = np.zeros((self.batch_size, self.k_max, d_out), np.float32)
+        alive = np.zeros((self.batch_size, self.k_max), bool)
+        for uid, tensors in results.items():
+            out = np.asarray(tensors[0], np.float32)
+            for position, (sample, slot) in enumerate(self.jobs[uid][1]):
+                outputs[sample, slot] = out[position]
+                alive[sample, slot] = True
+        real_slots = [sum(e is not None for e in row) for row in self.experts_per_sample]
+        short = np.flatnonzero(alive.sum(1) < np.minimum(self.k_min, real_slots))
+        if short.size:
+            raise RuntimeError(
+                f"samples {short.tolist()} got fewer than k_min={self.k_min} expert responses"
+            )
+        return outputs, alive
+
+    def _output_dim(self) -> int:
+        last_error: Optional[Exception] = None
+        for expert, _ in self.jobs.values():
+            try:
+                schema = expert.info["outputs_schema"][0]
+                return int(np.prod(schema.shape[1:]))
+            except Exception as e:  # expert unreachable: its schema can't be fetched
+                last_error = e
+        raise RuntimeError(f"could not fetch any expert's output schema: {last_error!r}")
+
+    # ------------------------------------------------------------------ backward
+
+    def _backward_np(self, x: np.ndarray, grad_outputs: np.ndarray, alive: np.ndarray) -> np.ndarray:
+        x = np.asarray(x, np.float32)
+        grad_outputs = np.asarray(grad_outputs, np.float32)
+        alive = np.asarray(alive, bool)
+        # only experts that answered the forward participate in the backward
+        live_uids = [
+            uid
+            for uid, (_e, positions) in self.jobs.items()
+            if any(alive[sample, slot] for sample, slot in positions)
+        ]
+
+        async def call_backward(expert: RemoteExpert, uid: str):
+            positions = self.jobs[uid][1]
+            samples = [s for s, _ in positions]
+            grads = np.stack([grad_outputs[s, slot] for s, slot in positions])
+            return await expert._call("backward", [x[samples], grads])
+
+        results = get_loop_runner().run_coroutine(
+            self._fan_out(call_backward, self.backward_k_min, self.backward_timeout, live_uids)
+        )
+
+        grad_x = np.zeros_like(x)
+        grads_per_sample = [0] * self.batch_size
+        for uid, tensors in results.items():
+            grad = np.asarray(tensors[0], np.float32)
+            for position, (sample, _slot) in enumerate(self.jobs[uid][1]):
+                grad_x[sample] += grad[position]
+                grads_per_sample[sample] += 1
+        short = [
+            s
+            for s, row in enumerate(self.experts_per_sample)
+            if grads_per_sample[s] < min(self.backward_k_min, sum(e is not None for e in row))
+        ]
+        if short:
+            raise RuntimeError(
+                f"samples {short} got fewer than backward_k_min={self.backward_k_min} gradients"
+            )
+        return grad_x
+
+    # ------------------------------------------------------------------ jax surface
+
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        d_out = self._output_dim()
+        batch, k = self.batch_size, self.k_max
+        outer = self
+
+        @jax.custom_vjp
+        def call_many(x):
+            out, mask = jax.pure_callback(
+                outer._forward_np,
+                (
+                    jax.ShapeDtypeStruct((batch, k, d_out), jnp.float32),
+                    jax.ShapeDtypeStruct((batch, k), jnp.bool_),
+                ),
+                x,
+            )
+            return out, mask
+
+        def fwd(x):
+            out, mask = call_many(x)
+            return (out, mask), (x, mask)
+
+        def bwd(residuals, cotangents):
+            x, mask = residuals
+            g_out, _g_mask = cotangents
+            grad_x = jax.pure_callback(
+                outer._backward_np,
+                jax.ShapeDtypeStruct(x.shape, jnp.float32),
+                x,
+                g_out,
+                mask,
+            )
+            return (grad_x.astype(x.dtype),)
+
+        call_many.defvjp(fwd, bwd)
+        return call_many(x)

@@ -106,44 +106,59 @@ class RemoteExpert:
 
         return await deserialize_tensor_stream(parts())
 
-    def forward_np(self, x: np.ndarray) -> np.ndarray:
-        return RemoteExpertWorker.run_coroutine(self._call("forward", [x]))[0]
+    def forward_np(self, *xs: np.ndarray) -> List[np.ndarray]:
+        return RemoteExpertWorker.run_coroutine(self._call("forward", list(xs)))
 
-    def backward_np(self, x: np.ndarray, grad_out: np.ndarray) -> np.ndarray:
-        return RemoteExpertWorker.run_coroutine(self._call("backward", [x, grad_out]))[0]
+    def backward_np(self, *tensors: np.ndarray) -> List[np.ndarray]:
+        """``tensors`` = forward inputs followed by one grad per output."""
+        return RemoteExpertWorker.run_coroutine(self._call("backward", list(tensors)))
 
     # ------------------------------------------------------------------ jax surface
 
-    def __call__(self, x: jax.Array) -> jax.Array:
-        """Differentiable remote call. Output shape is derived from the expert's
-        declared output schema with this call's batch size."""
-        out_schema = self.info["outputs_schema"][0]
-        out_shape = (x.shape[0], *out_schema.shape[1:])
-        out_dtype = jnp.float32
+    def __call__(self, *xs: jax.Array):
+        """Differentiable remote call; supports multi-input/multi-output expert
+        schemas (reference module_backend.py:68-74). Returns one array for
+        single-output experts, a tuple otherwise. Output shapes derive from the
+        expert's declared schemas with this call's batch size."""
+        out_schemas = self.info["outputs_schema"]
+        batch = xs[0].shape[0]
+        out_structs = tuple(
+            jax.ShapeDtypeStruct((batch, *schema.shape[1:]), jnp.float32) for schema in out_schemas
+        )
+        single_output = len(out_structs) == 1
         expert = self
 
         @jax.custom_vjp
-        def remote_call(x):
-            return jax.pure_callback(
-                lambda xx: expert.forward_np(np.asarray(xx)).astype(np.float32),
-                jax.ShapeDtypeStruct(out_shape, out_dtype),
-                x,
+        def remote_call(*xs):
+            outs = jax.pure_callback(
+                lambda *aa: tuple(
+                    np.asarray(o, np.float32)
+                    for o in expert.forward_np(*(np.asarray(a) for a in aa))
+                ),
+                out_structs,
+                *xs,
             )
+            return outs[0] if single_output else tuple(outs)
 
-        def fwd(x):
-            return remote_call(x), x
+        def fwd(*xs):
+            return remote_call(*xs), xs
 
-        def bwd(residual_x, g):
-            grad_in = jax.pure_callback(
-                lambda xx, gg: expert.backward_np(np.asarray(xx), np.asarray(gg)).astype(np.float32),
-                jax.ShapeDtypeStruct(residual_x.shape, jnp.float32),
-                residual_x,
-                g,
+        def bwd(residual_xs, g):
+            grads_out = (g,) if single_output else tuple(g)
+            grad_structs = tuple(jax.ShapeDtypeStruct(x.shape, jnp.float32) for x in residual_xs)
+            grads_in = jax.pure_callback(
+                lambda *aa: tuple(
+                    np.asarray(gg, np.float32)
+                    for gg in expert.backward_np(*(np.asarray(a) for a in aa))
+                ),
+                grad_structs,
+                *residual_xs,
+                *grads_out,
             )
-            return (grad_in.astype(residual_x.dtype),)
+            return tuple(g_in.astype(x.dtype) for g_in, x in zip(grads_in, residual_xs))
 
         remote_call.defvjp(fwd, bwd)
-        return remote_call(x)
+        return remote_call(*xs)
 
     def __repr__(self):
         return f"RemoteExpert({self.uid} @ {self.peer_id})"

@@ -1,14 +1,15 @@
 """RemoteMixtureOfExperts: route each input to its top-k experts across the swarm and
 mix their outputs (capability parity: reference hivemind/moe/client/moe.py:25-442).
 
-Host-orchestrated: gating + mixing are differentiable jax ops; expert calls go through
-RemoteExpert's custom_vjp (RPC on both passes). Fault tolerance mirrors the
-reference's _RemoteCallMany: experts that fail are masked out of the softmax, and the
-forward proceeds if at least ``k_min`` experts responded per sample."""
+Host-orchestrated gating, device-vectorized mixing: expert fan-out happens through
+ONE batched RemoteCallMany primitive (concurrent RPCs, alive-mask fault tolerance —
+reference _RemoteCallMany) and the mixture itself is a single masked-softmax einsum
+over [batch, k] slots, not per-sample Python loops. ``k_min``/``backward_k_min``
+bound how many experts must answer per sample; ``timeout_after_k_min`` caps how long
+stragglers are awaited once enough answered."""
 
 from __future__ import annotations
 
-from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -17,6 +18,7 @@ import numpy as np
 
 from hivemind_tpu.dht import DHT
 from hivemind_tpu.moe.client.beam_search import MoEBeamSearcher
+from hivemind_tpu.moe.client.call_many import RemoteCallMany
 from hivemind_tpu.moe.client.expert import RemoteExpert
 from hivemind_tpu.moe.expert_uid import ExpertInfo
 from hivemind_tpu.p2p import P2P
@@ -28,7 +30,10 @@ logger = get_logger(__name__)
 class RemoteMixtureOfExperts:
     """:param grid_size: experts live on a grid of this shape under uid_prefix
     :param k_best: experts per sample
-    :param k_min: minimum experts that must respond (reference k_min semantics)"""
+    :param k_min: minimum experts that must respond (reference k_min semantics)
+    :param backward_k_min: minimum experts whose backward must succeed per sample
+    :param timeout_after_k_min: extra seconds granted to stragglers once every
+        sample has k_min responses (reference moe.py:41-44)"""
 
     def __init__(
         self,
@@ -39,6 +44,10 @@ class RemoteMixtureOfExperts:
         uid_prefix: str,
         k_best: int = 4,
         k_min: int = 1,
+        backward_k_min: int = 1,
+        forward_timeout: Optional[float] = None,
+        backward_timeout: Optional[float] = None,
+        timeout_after_k_min: Optional[float] = None,
         beam_size: Optional[int] = None,
         seed: int = 0,
     ):
@@ -47,7 +56,9 @@ class RemoteMixtureOfExperts:
 
         self.p2p: P2P = get_loop_runner().run_coroutine(dht.replicate_p2p())
         self.grid_size = tuple(grid_size)
-        self.k_best, self.k_min = k_best, k_min
+        self.k_best, self.k_min, self.backward_k_min = k_best, k_min, backward_k_min
+        self.forward_timeout, self.backward_timeout = forward_timeout, backward_timeout
+        self.timeout_after_k_min = timeout_after_k_min
         self.beam_size = beam_size if beam_size is not None else k_best * 2
         self.beam_searcher = MoEBeamSearcher(dht, uid_prefix, grid_size)
         rng = np.random.RandomState(seed)
@@ -90,64 +101,43 @@ class RemoteMixtureOfExperts:
 
     def _mix(self, x: jax.Array, grid_scores: List[jax.Array], chosen: List[List[ExpertInfo]]) -> jax.Array:
         batch_size = x.shape[0]
-        # group samples by expert so each expert gets ONE batched call
-        expert_to_samples: Dict[str, List[int]] = {}
-        sample_experts: List[List[ExpertInfo]] = []
-        for sample in range(batch_size):
-            infos = chosen[sample][: self.k_best]
-            sample_experts.append(infos)
-            for info in infos:
-                expert_to_samples.setdefault(info.uid, []).append(sample)
-        if not expert_to_samples:
+        sample_experts = [chosen[sample][: self.k_best] for sample in range(batch_size)]
+        if not any(sample_experts):
             raise RuntimeError("beam search found no experts; is any server declared on this grid?")
+        k = max(len(infos) for infos in sample_experts)
 
-        uid_to_info = {}
-        for sample_infos in sample_experts:
-            for info in sample_infos:
-                uid_to_info[info.uid] = info
+        # one batched, concurrent, fault-tolerant fan-out for the whole batch
+        rows = [
+            [self._get_expert(info) for info in infos] + [None] * (k - len(infos))
+            for infos in sample_experts
+        ]
+        call_many = RemoteCallMany(
+            rows,
+            k_min=self.k_min,
+            backward_k_min=self.backward_k_min,
+            forward_timeout=self.forward_timeout,
+            backward_timeout=self.backward_timeout,
+            timeout_after_k_min=self.timeout_after_k_min,
+        )
+        outputs, alive = call_many(x)  # [batch, k, d_out], [batch, k]
 
-        # fault-tolerant scatter: ALL experts are called concurrently (the reference's
-        # _RemoteCallMany, moe.py:114-139); a slow expert costs max(), not sum(), and
-        # failed experts are masked out of the softmax
-        expert_outputs: Dict[str, jax.Array] = {}
-        expert_sample_pos: Dict[str, Dict[int, int]] = {}
-
-        def _call_one(uid: str, samples: List[int]):
-            expert = self._get_expert(uid_to_info[uid])
-            sub = x[jnp.asarray(samples)]
-            return jax.block_until_ready(expert(sub))
-
-        with ThreadPoolExecutor(max_workers=max(len(expert_to_samples), 1)) as pool:
-            futures = {
-                uid: pool.submit(_call_one, uid, samples)
-                for uid, samples in expert_to_samples.items()
-            }
-            for uid, future in futures.items():
-                try:
-                    expert_outputs[uid] = future.result()
-                    expert_sample_pos[uid] = {s: i for i, s in enumerate(expert_to_samples[uid])}
-                except Exception as e:
-                    logger.warning(f"expert {uid} failed: {e!r}; masking it out")
-
-        if not expert_outputs:
-            raise RuntimeError("all chosen experts failed")
-
-        outputs = []
-        for sample in range(batch_size):
-            live: List[Tuple[jax.Array, jax.Array]] = []  # (logit, output)
-            for info in sample_experts[sample]:
-                if info.uid in expert_outputs:
-                    position = expert_sample_pos[info.uid][sample]
-                    live.append(
-                        (self._expert_logit(grid_scores, sample, info.uid), expert_outputs[info.uid][position])
-                    )
-            if len(live) < self.k_min:
-                raise RuntimeError(f"sample {sample}: only {len(live)} experts responded (k_min={self.k_min})")
-            logits = jnp.stack([logit for logit, _ in live])
-            weights = jax.nn.softmax(logits)
-            stacked = jnp.stack([out for _, out in live])
-            outputs.append(jnp.einsum("e,ed->d", weights, stacked))
-        return jnp.stack(outputs)
+        # vectorized gating: logit[b, slot] = sum_d grid_scores[d][b, coord_d]
+        ndim = len(self.grid_size)
+        coords = np.zeros((batch_size, k, ndim), np.int32)
+        valid = np.zeros((batch_size, k), bool)
+        for sample, infos in enumerate(sample_experts):
+            for slot, info in enumerate(infos):
+                coords[sample, slot] = self._uid_coords(info.uid)
+                valid[sample, slot] = True
+        rows_index = jnp.arange(batch_size)[:, None]
+        logits = sum(
+            grid_scores[dim][rows_index, jnp.asarray(coords[:, :, dim])] for dim in range(ndim)
+        )
+        mask = jnp.asarray(valid) & alive
+        logits = jnp.where(mask, logits, -1e9)  # finite: -inf NaNs the softmax grad
+        weights = jax.nn.softmax(logits, axis=-1)
+        weights = jnp.where(mask, weights, 0.0)  # dead slots contribute exactly zero
+        return jnp.einsum("bk,bkd->bd", weights, outputs)
 
 
 class RemoteSwitchMixtureOfExperts(RemoteMixtureOfExperts):

@@ -55,14 +55,22 @@ class ConnectionHandler(ServicerBase):
         pool = self.forward_pools.get(uid)
         if pool is None:
             raise KeyError(f"unknown expert {uid!r}")
-        return await pool.submit_task(tensors[0])
+        backend = self.backends[uid]
+        assert len(tensors) == backend.num_inputs, (
+            f"expert {uid!r} takes {backend.num_inputs} tensors, got {len(tensors)}"
+        )
+        return await pool.submit_task(*tensors)
 
     async def _run_backward(self, uid: str, tensors: List[np.ndarray]) -> List[np.ndarray]:
         pool = self.backward_pools.get(uid)
         if pool is None:
             raise KeyError(f"unknown expert {uid!r}")
-        assert len(tensors) >= 2, "backward needs (inputs, grad_outputs)"
-        return await pool.submit_task(tensors[0], tensors[1])
+        backend = self.backends[uid]
+        expected = backend.num_inputs + backend.num_outputs
+        assert len(tensors) == expected, (
+            f"expert {uid!r} backward takes {expected} tensors (inputs + output grads), got {len(tensors)}"
+        )
+        return await pool.submit_task(*tensors)
 
     async def rpc_forward(self, request: runtime_pb2.ExpertRequest, context: P2PContext) -> runtime_pb2.ExpertResponse:
         inputs = [deserialize_tensor(t) for t in request.tensors]

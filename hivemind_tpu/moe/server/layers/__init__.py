@@ -11,6 +11,10 @@ from hivemind_tpu.moe.server.layers.common import (
     name_to_input,
     register_expert_class,
 )
+from hivemind_tpu.moe.server.layers.dropout import (
+    DeterministicDropout,
+    DeterministicDropoutExpert,
+)
 from hivemind_tpu.moe.server.layers.optim import (
     clipped,
     lamb_with_warmup,

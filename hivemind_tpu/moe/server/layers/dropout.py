@@ -1,0 +1,50 @@
+"""Deterministic dropout expert (capability parity: reference
+hivemind/moe/server/layers/dropout.py).
+
+The dropout MASK travels as a SECOND input tensor, so forward and backward apply the
+exact same mask on the server even though they are separate RPCs — RNG-local dropout
+cannot guarantee that across the wire. A natural fit for the multi-tensor expert
+schema (``ModuleBackend(sample_inputs=...)``): the jax vjp of ``x * mask / keep``
+reproduces the reference's custom autograd Function for free."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hivemind_tpu.moe.server.layers.common import register_expert_class
+
+
+class DeterministicDropout(nn.Module):
+    """Dropout whose mask is an explicit input (reference dropout.py:19-34)."""
+
+    drop_prob: float
+
+    @nn.compact
+    def __call__(self, x, mask):
+        keep_prob = 1.0 - self.drop_prob
+        return x * mask / keep_prob
+
+
+def dropout_sample_input(batch_size: int, hid_dim: int):
+    mask = (np.random.rand(batch_size, hid_dim) > 0.2).astype(np.float32)
+    return np.zeros((batch_size, hid_dim), np.float32), mask
+
+
+@register_expert_class("det_dropout", dropout_sample_input)
+class DeterministicDropoutExpert(nn.Module):
+    """linear -> deterministic dropout -> relu -> linear (reference dropout.py:42-53)."""
+
+    hidden_dim: int
+    dropout_prob: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, mask):
+        x = DeterministicDropout(self.dropout_prob)(x, mask)
+        h = nn.Dense(2 * self.hidden_dim, dtype=jnp.bfloat16, param_dtype=jnp.float32)(x)
+        h = jax.nn.relu(h)
+        return nn.Dense(self.hidden_dim, dtype=jnp.bfloat16, param_dtype=jnp.float32)(h).astype(
+            jnp.float32
+        )

@@ -33,9 +33,12 @@ def bucket_batch_size(n: int, max_batch_size: int) -> int:
 class ModuleBackend:
     """See module docstring.
 
-    :param module: a flax module whose __call__ takes one input array
+    :param module: a flax module; __call__ may take SEVERAL input arrays and return
+        one array or a tuple of arrays (nested expert schemas, reference
+        module_backend.py:68-74)
     :param optimizer: optax transformation applied on every backward batch
-    :param sample_input: schema-defining input WITH batch dim (any batch size)
+    :param sample_input: schema-defining input WITH batch dim (single-input experts)
+    :param sample_inputs: schema-defining inputs for multi-input experts
     """
 
     def __init__(
@@ -44,35 +47,50 @@ class ModuleBackend:
         module,
         *,
         optimizer,
-        sample_input: np.ndarray,
+        sample_input: Optional[np.ndarray] = None,
+        sample_inputs: Optional[Sequence[np.ndarray]] = None,
         max_batch_size: int = 4096,
         rng_seed: int = 0,
     ):
+        assert (sample_input is None) != (sample_inputs is None), (
+            "provide exactly one of sample_input / sample_inputs"
+        )
+        if sample_inputs is None:
+            sample_inputs = (sample_input,)
         self.name, self.module, self.optimizer = name, module, optimizer
         self.max_batch_size = max_batch_size
-        sample = jnp.asarray(sample_input[:1])
-        self.params = module.init(jax.random.PRNGKey(rng_seed), sample)["params"]
+        samples = tuple(jnp.asarray(np.asarray(s)[:1]) for s in sample_inputs)
+        self.params = module.init(jax.random.PRNGKey(rng_seed), *samples)["params"]
         self.opt_state = optimizer.init(self.params)
         self._state_lock = threading.Lock()
         self.update_count = 0
 
-        sample_out = module.apply({"params": self.params}, sample)
-        self.forward_schema = (BatchTensorDescriptor.from_array(np.asarray(sample_input)),)
-        self.outputs_schema = (BatchTensorDescriptor.from_array(np.asarray(sample_out)),)
+        sample_out = module.apply({"params": self.params}, *samples)
+        outs = tuple(sample_out) if isinstance(sample_out, (tuple, list)) else (sample_out,)
+        self.num_inputs, self.num_outputs = len(samples), len(outs)
+        self._outputs_are_tuple = isinstance(sample_out, (tuple, list))
+        self.forward_schema = tuple(
+            BatchTensorDescriptor.from_array(np.asarray(s)) for s in sample_inputs
+        )
+        self.outputs_schema = tuple(BatchTensorDescriptor.from_array(np.asarray(o)) for o in outs)
+
+        def _as_tuple(value):
+            return tuple(value) if isinstance(value, (tuple, list)) else (value,)
 
         @jax.jit
-        def _forward(params, x):
-            return module.apply({"params": params}, x)
+        def _forward(params, *xs):
+            return _as_tuple(module.apply({"params": params}, *xs))
 
         @jax.jit
-        def _backward(params, opt_state, x, grad_out):
+        def _backward(params, opt_state, xs, grad_outs):
             import optax
 
-            out, vjp = jax.vjp(lambda p, xx: module.apply({"params": p}, xx), params, x)
-            grad_params, grad_x = vjp(grad_out)
+            out, vjp = jax.vjp(lambda p, xx: module.apply({"params": p}, *xx), params, tuple(xs))
+            cotangent = _as_tuple(grad_outs) if self._outputs_are_tuple else grad_outs[0]
+            grad_params, grad_xs = vjp(cotangent)
             updates, new_opt_state = optimizer.update(grad_params, opt_state, params)
             new_params = optax.apply_updates(params, updates)
-            return grad_x, new_params, new_opt_state
+            return grad_xs, new_params, new_opt_state
 
         self._jit_forward, self._jit_backward = _forward, _backward
 
@@ -86,26 +104,36 @@ class ModuleBackend:
             batch = np.pad(batch, pad_width)
         return jnp.asarray(batch), n
 
-    def forward(self, inputs: np.ndarray) -> np.ndarray:
+    def forward(self, *inputs: np.ndarray) -> List[np.ndarray]:
         """Inference on a concatenated batch (no parameter updates)."""
-        padded, n = self._pad(np.asarray(inputs, np.float32))
+        assert len(inputs) == self.num_inputs, (len(inputs), self.num_inputs)
+        padded = [self._pad(np.asarray(x, np.float32)) for x in inputs]
+        n = padded[0][1]
         with self._state_lock:
             params = self.params
-        out = self._jit_forward(params, padded)
-        return np.asarray(out)[:n]
+        outs = self._jit_forward(params, *(p for p, _ in padded))
+        return [np.asarray(out)[:n] for out in outs]
 
-    def backward(self, inputs: np.ndarray, grad_outputs: np.ndarray) -> np.ndarray:
-        """Gradient wrt inputs; ALSO applies one optimizer update to the expert
-        (reference on_backward: the server trains on every backward call)."""
-        padded_x, n = self._pad(np.asarray(inputs, np.float32))
-        padded_g, _ = self._pad(np.asarray(grad_outputs, np.float32))
+    def backward(self, *tensors: np.ndarray) -> List[np.ndarray]:
+        """Gradients wrt every input; ALSO applies one optimizer update to the expert
+        (reference on_backward: the server trains on every backward call).
+        ``tensors`` = the forward inputs followed by one grad per output."""
+        assert len(tensors) == self.num_inputs + self.num_outputs, (
+            len(tensors), self.num_inputs, self.num_outputs,
+        )
+        padded_x = [self._pad(np.asarray(x, np.float32)) for x in tensors[: self.num_inputs]]
+        padded_g = [self._pad(np.asarray(g, np.float32)) for g in tensors[self.num_inputs :]]
+        n = padded_x[0][1]
         with self._state_lock:
-            grad_x, new_params, new_opt_state = self._jit_backward(
-                self.params, self.opt_state, padded_x, padded_g
+            grad_xs, new_params, new_opt_state = self._jit_backward(
+                self.params,
+                self.opt_state,
+                tuple(p for p, _ in padded_x),
+                tuple(p for p, _ in padded_g),
             )
             self.params, self.opt_state = new_params, new_opt_state
             self.update_count += 1
-        return np.asarray(grad_x)[:n]
+        return [np.asarray(g)[:n] for g in grad_xs]
 
     # ------------------------------------------------------------------ metadata/state
 

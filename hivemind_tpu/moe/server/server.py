@@ -86,8 +86,12 @@ class Server:
         for uid in expert_uids:
             module = name_to_block[expert_cls](hidden_dim)
             sample = name_to_input[expert_cls](4, hidden_dim)
+            # multi-tensor experts (e.g. det_dropout) declare a tuple of inputs
+            sample_kwargs = (
+                {"sample_inputs": sample} if isinstance(sample, tuple) else {"sample_input": sample}
+            )
             backends[uid] = ModuleBackend(
-                uid, module, optimizer=optim_factory(), sample_input=sample,
+                uid, module, optimizer=optim_factory(), **sample_kwargs,
                 max_batch_size=max_batch_size, **backend_kwargs,
             )
         if checkpoint_dir is not None:

@@ -11,6 +11,9 @@
 //   DIAL      'D' <16B token> <target_id>-> 'O' then splice  (sent on a FRESH conn)
 //   ACCEPT    'A' <16B token>            -> 'O' then splice  (fresh conn from target)
 //   INCOMING  'I' <16B token>            relay -> target's control line
+//   WHOAMI    'W'                        -> 'O' <ip:port>  (the conn's observed
+//             public endpoint — the STUN-style observation NATed peers need for
+//             hole punching; role parity with libp2p identify/observed-addr)
 // After 'O' on a DIAL/ACCEPT pair the two sockets are spliced byte-for-byte.
 //
 // Build: g++ -O2 -std=c++17 -o relay_daemon relay_daemon.cpp   (see Makefile)
@@ -191,6 +194,18 @@ static void handle_control_frame(Conn* c, const std::string& payload) {
     g_pending_dials[token] = c->fd;
     c->created_ms = now_ms();
     queue_frame(g_conns[reg->second], std::string("I") + token);
+  } else if (kind == 'W') {
+    sockaddr_in observed{};
+    socklen_t olen = sizeof(observed);
+    if (getpeername(c->fd, (sockaddr*)&observed, &olen) == 0) {
+      char ip[INET_ADDRSTRLEN];
+      inet_ntop(AF_INET, &observed.sin_addr, ip, sizeof(ip));
+      char reply[64];
+      int n = snprintf(reply, sizeof(reply), "O%s:%d", ip, ntohs(observed.sin_port));
+      queue_frame(c, std::string(reply, n));
+    } else {
+      queue_frame(c, "E");
+    }
   } else if (kind == 'A' && payload.size() >= 17) {
     std::string token = payload.substr(1, 16);
     auto pend = g_pending_dials.find(token);

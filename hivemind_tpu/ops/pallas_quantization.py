@@ -1,0 +1,113 @@
+"""Pallas TPU kernels for blockwise int8 quantization — the device-side equivalent of
+the reference's bitsandbytes CUDA kernels (hivemind/compression/quantization.py:130-201).
+
+Layout: the flat tensor is viewed as [n_blocks, BLOCK_SIZE=4096] and the kernel
+processes ROWS_PER_STEP=32 quantization blocks per grid step, so the int8 store tile
+is exactly the TPU minimum (32, 128)-aligned shape (32, 4096) — one VMEM round trip
+computes absmax, scales, rounds, and casts without materializing fp32 intermediates
+in HBM. On non-TPU backends the same kernels run in Pallas interpret mode (used by
+the CPU test suite); the fused-jnp path in ops/quantization.py remains the fast
+host-side implementation and the dispatch helpers below pick per backend."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+ROWS_PER_STEP = 32  # int8 min sublane count: full tiles for the int8 store
+
+
+def _quantize_kernel(x_ref, codes_ref, absmax_ref):
+    x = x_ref[:]
+    absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    scale = jnp.where(absmax > 0, 127.0 / absmax, 0.0)
+    codes_ref[:] = jnp.clip(jnp.round(x * scale), -127, 127).astype(jnp.int8)
+    absmax_ref[:] = absmax
+
+
+def _dequantize_kernel(codes_ref, absmax_ref, out_ref):
+    scale = absmax_ref[:] / 127.0
+    out_ref[:] = codes_ref[:].astype(jnp.float32) * scale
+
+
+def _pad_rows(blocks: jax.Array) -> jax.Array:
+    n = blocks.shape[0]
+    remainder = n % ROWS_PER_STEP
+    if remainder:
+        blocks = jnp.pad(blocks, ((0, ROWS_PER_STEP - remainder), (0, 0)))
+    return blocks
+
+
+@partial(jax.jit, static_argnames=("block_size", "interpret"))
+def pallas_blockwise_quantize(flat: jax.Array, block_size: int = 4096, interpret: bool = False):
+    """Per-block absmax int8 quantization as one fused Pallas kernel.
+
+    :returns: (int8 codes [n_blocks, block_size], fp32 absmax [n_blocks])
+    """
+    blocks = flat.astype(jnp.float32).reshape(-1, block_size)
+    n_blocks = blocks.shape[0]
+    padded = _pad_rows(blocks)
+    rows = padded.shape[0]
+    codes, absmax = pl.pallas_call(
+        _quantize_kernel,
+        grid=(rows // ROWS_PER_STEP,),
+        in_specs=[pl.BlockSpec((ROWS_PER_STEP, block_size), lambda i: (i, 0))],
+        out_specs=[
+            pl.BlockSpec((ROWS_PER_STEP, block_size), lambda i: (i, 0)),
+            pl.BlockSpec((ROWS_PER_STEP, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, block_size), jnp.int8),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(padded)
+    return codes[:n_blocks], absmax[:n_blocks, 0]
+
+
+@partial(jax.jit, static_argnames=("block_size", "interpret"))
+def pallas_blockwise_dequantize(
+    codes: jax.Array, absmax: jax.Array, block_size: int = 4096, interpret: bool = False
+):
+    n_blocks = codes.shape[0]
+    padded_codes = _pad_rows(codes)
+    padded_absmax = _pad_rows(absmax.reshape(-1, 1))
+    rows = padded_codes.shape[0]
+    out = pl.pallas_call(
+        _dequantize_kernel,
+        grid=(rows // ROWS_PER_STEP,),
+        in_specs=[
+            pl.BlockSpec((ROWS_PER_STEP, block_size), lambda i: (i, 0)),
+            pl.BlockSpec((ROWS_PER_STEP, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((ROWS_PER_STEP, block_size), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, block_size), jnp.float32),
+        interpret=interpret,
+    )(padded_codes, padded_absmax)
+    return out[:n_blocks].reshape(-1)
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def blockwise_quantize_auto(flat, block_size: int = 4096):
+    """Backend dispatch: fused Pallas kernel on TPU, fused-jnp on host (interpret
+    mode exists for correctness testing, not speed)."""
+    if _on_tpu():
+        return pallas_blockwise_quantize(flat, block_size=block_size)
+    from hivemind_tpu.ops.quantization import blockwise_quantize
+
+    return blockwise_quantize(flat, block_size=block_size)
+
+
+def blockwise_dequantize_auto(codes, absmax, block_size: int = 4096):
+    if _on_tpu():
+        return pallas_blockwise_dequantize(codes, absmax, block_size=block_size)
+    from hivemind_tpu.ops.quantization import blockwise_dequantize
+
+    return blockwise_dequantize(codes, absmax, block_size=block_size)

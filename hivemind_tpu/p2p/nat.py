@@ -1,0 +1,110 @@
+"""NAT traversal helpers: reachability probing and hole punching (capability parity:
+reference hivemind/p2p/p2p_daemon.py:84-147, where the Go daemon's AutoNAT + AutoRelay
++ DCUtR flags provide the same three capabilities).
+
+- :class:`NATTraversal` registers two P2P handlers:
+
+  * ``nat.check`` — AutoNAT-style dial-back: a peer asks us to TCP-dial its
+    advertised addresses and report which ones are reachable from the outside.
+  * ``nat.punch`` — DCUtR-style coordination: two peers that can already exchange
+    messages (e.g. through a relay) swap their direct endpoints and SIMULTANEOUSLY
+    dial each other; whichever direction lands first becomes the direct connection
+    and replaces the relayed one for future streams.
+
+- :func:`RelayClient.whoami` (see relay.py) supplies the STUN-style observed
+  endpoint a NATed peer advertises for punching.
+
+Security note: real AutoNAT only dials back addresses that share the requester's
+observed IP so a prober cannot be used to scan third parties. The in-process
+transport does not expose per-connection remote addresses to handlers yet, so
+``nat.check`` instead refuses to probe more than ``MAX_PROBE_ADDRS`` addresses and
+never keeps the connection open beyond the TCP handshake."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List, Optional, Sequence
+
+from hivemind_tpu.p2p.peer_id import Multiaddr, PeerID
+from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.serializer import MSGPackSerializer
+
+logger = get_logger(__name__)
+
+MAX_PROBE_ADDRS = 4
+PROBE_TIMEOUT = 3.0
+PUNCH_TIMEOUT = 10.0
+
+
+class NATTraversal:
+    """Attach reachability + hole-punching to a P2P node."""
+
+    def __init__(self, p2p):
+        self.p2p = p2p
+        self._punch_tasks: set = set()  # strong refs: the loop holds tasks weakly
+
+    async def register_handlers(self) -> None:
+        await self.p2p.add_protobuf_handler("nat.check", self._rpc_check)
+        await self.p2p.add_protobuf_handler("nat.punch", self._rpc_punch)
+
+    # ------------------------------------------------------------------ reachability
+
+    async def _rpc_check(self, request: bytes, context) -> bytes:
+        addrs = MSGPackSerializer.loads(request)[:MAX_PROBE_ADDRS]
+        reachable = []
+        for addr in addrs:
+            try:
+                maddr = Multiaddr.parse(addr)
+                _reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(maddr.host, maddr.port), timeout=PROBE_TIMEOUT
+                )
+                writer.close()
+                reachable.append(addr)
+            except Exception:
+                continue
+        return MSGPackSerializer.dumps(reachable)
+
+    async def check_reachability(
+        self, via: PeerID, maddrs: Optional[Sequence] = None
+    ) -> List[str]:
+        """Ask ``via`` to dial our addresses back; returns the publicly-reachable
+        subset. An empty result on a working path means we are NATed and should
+        register at a relay (reference auto_relay, p2p_daemon.py:126-137)."""
+        maddrs = maddrs if maddrs is not None else self.p2p.get_visible_maddrs()
+        request = MSGPackSerializer.dumps([str(m) for m in maddrs])
+        response = await self.p2p.call_protobuf_handler(via, "nat.check", request)
+        return list(MSGPackSerializer.loads(response))
+
+    # ------------------------------------------------------------------ hole punching
+
+    async def _rpc_punch(self, request: bytes, context) -> bytes:
+        """The passive side: reply with our direct endpoints and immediately start
+        dialing the initiator's (TCP simultaneous open under real NATs)."""
+        their_addrs = [Multiaddr.parse(a) for a in MSGPackSerializer.loads(request)]
+        task = asyncio.create_task(self._punch_dial(context.remote_id, their_addrs))
+        self._punch_tasks.add(task)
+        task.add_done_callback(self._punch_tasks.discard)
+        return MSGPackSerializer.dumps([str(m) for m in self.p2p.get_visible_maddrs()])
+
+    async def _punch_dial(self, peer_id: PeerID, addrs: Sequence[Multiaddr]) -> bool:
+        for maddr in addrs:
+            try:
+                await asyncio.wait_for(
+                    self.p2p._dial(maddr.with_peer_id(peer_id), expected_peer=peer_id, replace_existing=True),
+                    timeout=PUNCH_TIMEOUT,
+                )
+                return True
+            except Exception as e:
+                logger.debug(f"punch dial to {maddr} failed: {e!r}")
+        return False
+
+    async def hole_punch(self, peer_id: PeerID, direct_addrs: Optional[Sequence] = None) -> bool:
+        """Coordinate a direct connection with a peer we can already message (through
+        a relay): exchange endpoints over the existing path, then both sides dial.
+        Returns True if a direct connection was established from our side (the
+        peer's dial may land first; either way the connection map is upgraded)."""
+        ours = direct_addrs if direct_addrs is not None else self.p2p.get_visible_maddrs()
+        request = MSGPackSerializer.dumps([str(m) for m in ours])
+        response = await self.p2p.call_protobuf_handler(peer_id, "nat.punch", request)
+        their_addrs = [Multiaddr.parse(a) for a in MSGPackSerializer.loads(response)]
+        return await self._punch_dial(peer_id, their_addrs)

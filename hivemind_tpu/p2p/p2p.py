@@ -130,6 +130,7 @@ class P2P:
         self._dial_locks: Dict[PeerID, asyncio.Lock] = {}
         self._peerstore: Dict[PeerID, Set[Multiaddr]] = {}
         self._dial_timeout = dial_timeout
+        self._bg_tasks: Set[asyncio.Task] = set()  # strong refs: loop holds tasks weakly
         self._alive_refs = 1  # P2P.replicate parity: shared instance refcount
         self._listen_host = listen_host
         self._announce_host = announce_host or listen_host
@@ -212,7 +213,12 @@ class P2P:
         conn = await self._dial(maddr, expected_peer=maddr.peer_id)
         return conn.peer_id
 
-    async def _dial(self, maddr: Multiaddr, expected_peer: Optional[PeerID]) -> MuxConnection:
+    async def _dial(
+        self, maddr: Multiaddr, expected_peer: Optional[PeerID], replace_existing: bool = False
+    ) -> MuxConnection:
+        """Dial one address. With ``replace_existing`` a live connection to the same
+        peer is superseded for FUTURE streams (hole-punch upgrade: the direct path
+        replaces the relayed one; in-flight streams finish on the old connection)."""
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(maddr.host, maddr.port), timeout=self._dial_timeout
         )
@@ -234,13 +240,30 @@ class P2P:
         self._register_peer_addrs(peer_id, extras.get("addrs", ()))
         existing = self._connections.get(peer_id)
         if existing is not None and not existing.is_closed:
-            channel.close()
-            return existing
+            if not replace_existing:
+                channel.close()
+                return existing
+            # superseded (e.g. relayed) connection: let in-flight streams finish,
+            # then close it — otherwise every punch upgrade leaks a socket on both
+            # ends plus a spliced pair on the relay
+            self._close_after_grace(existing)
         conn = MuxConnection(channel, peer_id, is_initiator=True, on_inbound_stream=self._route_stream)
         self._connections[peer_id] = conn
         self._all_connections.add(conn)
         conn.start()
         return conn
+
+    def _close_after_grace(self, conn: MuxConnection, grace: float = 30.0) -> None:
+        """Close a superseded connection once in-flight streams have had time to
+        finish. The task is held strongly (the loop keeps only weak task refs)."""
+
+        async def _close():
+            await asyncio.sleep(grace)
+            await conn.close()
+
+        task = asyncio.create_task(_close())
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
 
     def _prune_dead_connections(self) -> None:
         dead = [c for c in self._all_connections if c.is_closed]
@@ -412,6 +435,8 @@ class P2P:
         if self._alive_refs > 0:
             return
         self._server.close()
+        for task in list(self._bg_tasks):
+            task.cancel()
         for conn in list(self._all_connections):
             await conn.close()
         self._all_connections.clear()

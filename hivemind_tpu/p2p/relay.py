@@ -120,6 +120,20 @@ class RelayClient:
         conn.start()
         return peer_id
 
+    async def whoami(self) -> Tuple[str, int]:
+        """The relay's view of our public endpoint (STUN-style observed address) —
+        what a NATed peer advertises for hole punching."""
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        try:
+            await _send_frame(writer, b"W")
+            response = await _recv_frame(reader)
+            if not response.startswith(b"O"):
+                raise ConnectionError(f"relay whoami failed: {response!r}")
+            host, port = response[1:].decode().rsplit(":", 1)
+            return host, int(port)
+        finally:
+            writer.close()
+
     async def close(self) -> None:
         if self._control_task is not None:
             self._control_task.cancel()

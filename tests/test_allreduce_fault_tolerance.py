@@ -21,6 +21,7 @@ class Fault(Enum):
     SLOW_SENDING = auto()  # stalls while sending
     FAIL_REDUCING = auto()  # returns one delta, then stops reducing
     SLOW_REDUCING = auto()  # stalls while reducing
+    CANCEL = auto()  # cancels its own step right after scheduling it
 
 
 class FaultyAllReduceRunner(AllReduceRunner):
@@ -122,7 +123,7 @@ def launch_faulty_swarm(n_peers: int, fault_index: int, fault: Fault, part_size_
 
 @pytest.mark.parametrize(
     "fault",
-    [Fault.NONE, Fault.FAIL_BEFORE, Fault.FAIL_SENDING, Fault.SLOW_SENDING, Fault.FAIL_REDUCING, Fault.SLOW_REDUCING],
+    [Fault.NONE, Fault.FAIL_BEFORE, Fault.FAIL_SENDING, Fault.SLOW_SENDING, Fault.FAIL_REDUCING, Fault.SLOW_REDUCING, Fault.CANCEL],
     ids=lambda f: f.name,
 )
 def test_allreduce_fault_tolerance(fault):
@@ -130,13 +131,20 @@ def test_allreduce_fault_tolerance(fault):
     dhts, averagers = launch_faulty_swarm(n_peers, fault_index, fault)
     try:
         controls = [a.step(wait=False, timeout=25, allow_retries=False) for a in averagers]
+        if fault == Fault.CANCEL:
+            # reference test_allreduce_fault_tolerance.py:22-120 CANCEL case: the
+            # faulty peer withdraws by cancelling its own step mid-matchmaking
+            import time
+
+            time.sleep(0.5)
+            controls[fault_index].cancel()
         survivor_results = {}
         for i, control in enumerate(controls):
             try:
                 result = control.result(timeout=40)
                 survivor_results[i] = result
             except Exception:
-                assert i == fault_index or fault in (Fault.SLOW_SENDING, Fault.SLOW_REDUCING), (
+                assert i == fault_index or fault in (Fault.SLOW_SENDING, Fault.SLOW_REDUCING, Fault.CANCEL), (
                     f"healthy peer {i} failed under fault {fault.name}"
                 )
         survivors = [i for i in survivor_results if i != fault_index]

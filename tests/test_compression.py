@@ -158,3 +158,25 @@ async def test_tensor_streaming_truncated_fails():
 
     with pytest.raises(ValueError, match="mid-tensor"):
         await deserialize_tensor_stream(stream())
+
+
+def test_pallas_blockwise_kernels_match_jnp():
+    """The Pallas TPU kernels (run here in interpret mode) must produce bit-identical
+    codes/absmax/dequant to the fused-jnp host path the codec uses on CPU."""
+    import jax
+    from hivemind_tpu.ops.pallas_quantization import (
+        pallas_blockwise_dequantize,
+        pallas_blockwise_quantize,
+    )
+    from hivemind_tpu.ops.quantization import blockwise_dequantize, blockwise_quantize
+
+    rng = np.random.RandomState(7)
+    flat = rng.randn(3 * 4096).astype(np.float32)  # 3 rows: exercises row padding
+    codes_p, absmax_p = pallas_blockwise_quantize(flat, interpret=True)
+    codes_j, absmax_j = blockwise_quantize(flat)
+    np.testing.assert_array_equal(np.asarray(codes_p), np.asarray(codes_j))
+    np.testing.assert_allclose(np.asarray(absmax_p), np.asarray(absmax_j))
+    out_p = pallas_blockwise_dequantize(codes_p, absmax_p, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out_p), np.asarray(blockwise_dequantize(codes_j, absmax_j))
+    )

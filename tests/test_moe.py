@@ -42,14 +42,14 @@ def test_module_backend_numerics():
         sample_input=np.zeros((4, HID), np.float32), max_batch_size=64,
     )
     x = np.random.RandomState(0).randn(5, HID).astype(np.float32)
-    out = backend.forward(x)
+    out = backend.forward(x)[0]
     expected = module.apply({"params": backend.params}, jnp.asarray(x))
     assert np.allclose(out, np.asarray(expected), atol=2e-2)  # bf16 compute tolerance
 
     # backward returns input grads AND trains the expert
     params_before = [np.asarray(l).copy() for l in jax.tree_util.tree_leaves(backend.params)]
     grad_out = np.ones_like(out)
-    grad_in = backend.backward(x, grad_out)
+    grad_in = backend.backward(x, grad_out)[0]
     assert grad_in.shape == x.shape and np.isfinite(grad_in).all()
     params_after = [np.asarray(l) for l in jax.tree_util.tree_leaves(backend.params)]
     assert any(not np.array_equal(a, b) for a, b in zip(params_before, params_after))
@@ -155,7 +155,7 @@ def test_background_server_contextmanager():
         optim_factory=lambda: optax.sgd(1e-3),
     ) as (dht, server):
         assert dht.is_alive and "bgctx.0" in server.backends
-        out = server.backends["bgctx.0"].forward(np.ones((2, 8), np.float32))
+        out = server.backends["bgctx.0"].forward(np.ones((2, 8), np.float32))[0]
         assert out.shape == (2, 8)
     assert not dht.is_alive  # context exit shuts everything down
 
@@ -181,3 +181,130 @@ def test_checkpoints_roundtrip(tmp_path):
     new_leaf = jax.tree_util.tree_leaves(fresh.params)[0]
     assert np.allclose(np.asarray(old_leaf), np.asarray(new_leaf))
     assert fresh.update_count == 1
+
+
+def test_multi_tensor_expert_backend_and_remote():
+    """Experts with several inputs AND several outputs work locally and over RPC
+    (reference module_backend.py:68-74 nested schemas)."""
+    import flax.linen as nn
+
+    class TwoInTwoOut(nn.Module):
+        hid: int
+
+        @nn.compact
+        def __call__(self, x, y):
+            h = nn.Dense(self.hid)(x) + y
+            return h, jnp.tanh(h)
+
+    backend = ModuleBackend(
+        "multi.0", TwoInTwoOut(HID), optimizer=optax.sgd(1e-3),
+        sample_inputs=[np.zeros((2, HID), np.float32), np.zeros((2, HID), np.float32)],
+        max_batch_size=64,
+    )
+    assert backend.num_inputs == 2 and backend.num_outputs == 2
+    rng = np.random.RandomState(0)
+    x, y = rng.randn(3, HID).astype(np.float32), rng.randn(3, HID).astype(np.float32)
+    out1, out2 = backend.forward(x, y)
+    ref1, ref2 = backend.module.apply({"params": backend.params}, jnp.asarray(x), jnp.asarray(y))
+    assert np.allclose(out1, np.asarray(ref1), atol=1e-4)
+    assert np.allclose(out2, np.asarray(ref2), atol=1e-4)
+    grads = backend.backward(x, y, np.ones_like(out1), np.ones_like(out2))
+    assert len(grads) == 2 and grads[0].shape == x.shape and grads[1].shape == y.shape
+    assert backend.update_count == 1
+
+    # over RPC: schemas travel through rpc_info, both passes work, grads flow to
+    # EVERY input
+    dht = DHT(start=True)
+    server = Server(dht, {"multi.0": backend})
+    try:
+        server.run_in_background(await_ready=True)
+        client_dht = DHT(initial_peers=[str(m) for m in dht.get_visible_maddrs()], start=True)
+        expert = RemoteExpert(ExpertInfo("multi.0", dht.peer_id), client_dht.node.p2p)
+        r_out1, r_out2 = expert(jnp.asarray(x), jnp.asarray(y))
+        # the local backward above trained the expert: compare against CURRENT params
+        now1, now2 = backend.forward(x, y)
+        assert np.allclose(np.asarray(r_out1), now1, atol=1e-4)
+        assert np.allclose(np.asarray(r_out2), now2, atol=1e-4)
+
+        def loss_fn(xx, yy):
+            a, b = expert(xx, yy)
+            return jnp.sum(a ** 2) + jnp.sum(b ** 2)
+
+        gx, gy = jax.grad(loss_fn, argnums=(0, 1))(jnp.asarray(x), jnp.asarray(y))
+        assert gx.shape == x.shape and gy.shape == y.shape
+        assert bool(jnp.isfinite(gx).all()) and bool(jnp.isfinite(gy).all())
+        assert bool((jnp.abs(gy) > 0).any())
+        client_dht.shutdown()
+    finally:
+        server.shutdown()
+        dht.shutdown()
+
+
+def test_call_many_masks_dead_experts():
+    """RemoteCallMany: a dead expert is masked out (k_min still satisfied), gradients
+    flow through the survivors, and k_min violations raise."""
+    from hivemind_tpu.moe.client.call_many import RemoteCallMany
+
+    server = make_server()
+    try:
+        import time
+        time.sleep(1.0)
+        client_dht = DHT(initial_peers=[str(m) for m in server.dht.get_visible_maddrs()], start=True)
+        infos = get_experts(server.dht, ["ffn_test.0.0", "ffn_test.0.1"])
+        good = [RemoteExpert(info, client_dht.node.p2p) for info in infos]
+        dead = RemoteExpert(ExpertInfo("ffn_test.9.9", server.dht.peer_id), client_dht.node.p2p)
+
+        x = jnp.asarray(np.random.RandomState(2).randn(4, HID), jnp.float32)
+        rows = [[good[0], dead], [good[1], dead], [good[0], good[1]], [good[1], dead]]
+        rcm = RemoteCallMany(rows, k_min=1, backward_k_min=1, forward_timeout=20)
+        outputs, alive = rcm(x)
+        alive = np.asarray(alive)
+        assert outputs.shape == (4, 2, HID)
+        assert alive[:, 0].all() and alive[2, 1] and not alive[0, 1] and not alive[3, 1]
+
+        def loss_fn(xx):
+            out, live = RemoteCallMany(rows, k_min=1, forward_timeout=20)(xx)
+            return jnp.sum(out ** 2)
+
+        grads = jax.grad(loss_fn)(x)
+        assert grads.shape == x.shape and bool(jnp.isfinite(grads).all())
+
+        # k_min=2 with only one live expert on a row must raise
+        rcm_strict = RemoteCallMany([[good[0], dead]], k_min=2, forward_timeout=10)
+        with pytest.raises(Exception):
+            jax.block_until_ready(rcm_strict(x[:1])[0])
+        client_dht.shutdown()
+    finally:
+        server.shutdown()
+        server.dht.shutdown()
+
+
+def test_deterministic_dropout_expert():
+    """det_dropout: the mask is a second input; forward/backward see the same mask
+    over RPC and the mask gates the gradient (reference layers/dropout.py)."""
+    server = Server.create(
+        expert_uids=["drop.0"], expert_cls="det_dropout", hidden_dim=16,
+        start=True, optim_factory=lambda: optax.sgd(1e-3),
+    )
+    try:
+        import time
+        time.sleep(0.5)
+        client_dht = DHT(initial_peers=[str(m) for m in server.dht.get_visible_maddrs()], start=True)
+        expert = RemoteExpert(ExpertInfo("drop.0", server.dht.peer_id), client_dht.node.p2p)
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(3, 16), jnp.float32)
+        mask = jnp.asarray((rng.rand(3, 16) > 0.2), jnp.float32)
+        out = expert(x, mask)
+        backend = server.backends["drop.0"]
+        expected = backend.module.apply({"params": backend.params}, x, mask)
+        assert np.allclose(np.asarray(out), np.asarray(expected), atol=2e-2)
+
+        # gradient wrt x must be zero exactly where the mask dropped the input
+        grads = jax.grad(lambda xx: jnp.sum(expert(xx, mask) ** 2))(x)
+        dropped = np.asarray(mask) == 0
+        assert np.allclose(np.asarray(grads)[dropped], 0.0, atol=1e-6)
+        assert np.abs(np.asarray(grads)[~dropped]).max() > 0
+        client_dht.shutdown()
+    finally:
+        server.shutdown()
+        server.dht.shutdown()
