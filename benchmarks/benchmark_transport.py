@@ -1,0 +1,77 @@
+"""Internet-tier transport throughput: encrypted mux stream MB/s between two peers
+over localhost TCP (the measured justification that the Python asyncio + Noise-AEAD
+data path saturates internet-grade links; the ICI tier handles intra-pod bandwidth —
+see docs/design_notes.md and SURVEY §5 two-tier backend)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
+
+import argparse
+import asyncio
+import json
+import time
+
+import numpy as np
+
+
+async def run(args):
+    from hivemind_tpu.p2p import P2P, P2PContext
+    from hivemind_tpu.proto import runtime_pb2
+    from hivemind_tpu.compression import serialize_tensor, split_tensor_for_streaming
+
+    server = await P2P.create()
+    client = await P2P.create()
+    received = []
+
+    async def sink(requests, context: P2PContext):
+        total = 0
+        async for message in requests:
+            for tensor in message.tensors:
+                total += len(tensor.buffer)
+        received.append(total)
+        yield runtime_pb2.ExpertResponse()
+
+    await server.add_protobuf_handler(
+        "sink", sink, runtime_pb2.ExpertRequest, stream_input=True, stream_output=True
+    )
+    await client.connect(server.get_visible_maddrs()[0])
+
+    payload = np.random.RandomState(0).randn(args.mbytes * 1024 * 1024 // 4).astype(np.float32)
+    serialized = serialize_tensor(payload)
+
+    async def requests():
+        for chunk in split_tensor_for_streaming(serialized, 2**20):
+            yield runtime_pb2.ExpertRequest(uid="bench", tensors=[chunk])
+
+    start = time.perf_counter()
+    async for _response in client.iterate_protobuf_handler(
+        server.peer_id, "sink", requests(), runtime_pb2.ExpertResponse
+    ):
+        pass
+    elapsed = time.perf_counter() - start
+
+    mb = received[0] / 1e6
+    print(json.dumps({
+        "metric": "transport_stream_throughput",
+        "value": round(mb / elapsed, 1),
+        "unit": "MB/s",
+        "extra": {
+            "payload_mb": round(mb, 1), "seconds": round(elapsed, 3),
+            "path": "tcp + noise AEAD + mux, localhost",
+        },
+    }))
+    await client.shutdown()
+    await server.shutdown()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mbytes", type=int, default=256)
+    args = parser.parse_args()
+    asyncio.run(run(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -257,10 +257,8 @@ class AllReduceRunner:
                     # failing the laggards may resolve the part right now — the
                     # on-time sender whose wait expired must still get its delta
                     self._fail_laggards(part_index)
-                    state = self.reducer._parts.get(part_index)
-                    if state is not None and state["future"].done() and state["future"].exception() is None:
-                        averaged = state["future"].result()
-                    else:
+                    averaged = self.reducer.result_nowait(part_index)
+                    if averaged is None:
                         yield averaging_pb2.AveragingData(code=averaging_pb2.CANCELLED)
                         return
                 delta = averaged - part.astype(np.float32)
@@ -290,12 +288,8 @@ class AllReduceRunner:
 
     def _fail_laggards(self, part_index: int) -> None:
         """A part timed out: fail every sender that has not contributed to it."""
-        state = self.reducer._parts.get(part_index)
-        if state is None:
-            return
-        for rank in range(self.reducer.num_senders):
-            if not state["contributed"][rank] and not self.reducer.sender_failed[rank]:
-                self._ban_sender(rank, f"no part {part_index} within reducer_timeout")
+        for rank in self.reducer.pending_senders(part_index):
+            self._ban_sender(rank, f"no part {part_index} within reducer_timeout")
 
     async def _sender_watchdog(self) -> None:
         """Fail senders that never open their stream OR stall mid-stream
@@ -319,8 +313,7 @@ class AllReduceRunner:
         """AUX mode: stay alive until every part of our span is reduced."""
         num_parts = len(self.reducer.part_shapes)
         for part_index in range(num_parts):
-            state = self.reducer._part_state(part_index)
             try:
-                await asyncio.wait_for(asyncio.shield(state["future"]), timeout=self.reducer_timeout)
+                await self.reducer.wait_part(part_index, timeout=self.reducer_timeout)
             except (asyncio.TimeoutError, AllreduceException):
                 self._fail_laggards(part_index)

@@ -57,6 +57,12 @@ class Optimizer:
         state_averager.py:478-574 background executor)
     :param delay_grad_averaging: alias that implies delay_optimizer_step (kept for
         reference API parity; the background task always overlaps both)
+    :param delay_state_averaging: run the periodic state-averaging round on a
+        background thread (reference optimizer.py:129-130). Independent of
+        delay_optimizer_step — with ``use_local_updates`` this is the canonical
+        local-SGD combination (pair with ``delta_rule_averaging`` so local steps
+        taken during the round survive). In full DPU mode the whole transition is
+        already backgrounded, so the flag adds nothing there.
     :param delta_rule_averaging: apply state-averaging results as deltas so optimizer
         steps running concurrently with the round survive (required for DPU/local
         updates; reference state_averager.py:73-74)
@@ -78,6 +84,7 @@ class Optimizer:
         use_local_updates: bool = False,
         delay_optimizer_step: bool = False,
         delay_grad_averaging: bool = False,
+        delay_state_averaging: bool = False,
         delta_rule_averaging: bool = False,
         client_mode: bool = False,
         auxiliary: bool = False,
@@ -105,6 +112,7 @@ class Optimizer:
         self.use_local_updates = use_local_updates
         self.delay_optimizer_step = delay_optimizer_step or delay_grad_averaging
         self.delay_grad_averaging = delay_grad_averaging
+        self.delay_state_averaging = delay_state_averaging
         assert not (self.delay_optimizer_step and use_local_updates), (
             "delayed updates apply to collaborative (gradient-averaging) mode"
         )
@@ -115,7 +123,7 @@ class Optimizer:
         self._step_lock = threading.Lock()
         self._update_executor: Optional[ThreadPoolExecutor] = (
             ThreadPoolExecutor(max_workers=1, thread_name_prefix="hm_dpu")
-            if self.delay_optimizer_step
+            if (self.delay_optimizer_step or delay_state_averaging)
             else None
         )
         self._pending_update: Optional[Future] = None
@@ -240,10 +248,21 @@ class Optimizer:
         if self.tracker.ready_to_update_epoch:
             self.state_averager.local_epoch += 1
             if self.local_epoch % self.average_state_every == 0:
-                self.state_averager.do_averaging_round(
-                    timeout=self.averaging_timeout,
-                    scheduled_time=get_dht_time() + self.matchmaking_time,
-                )
+                if self.delay_state_averaging and self._update_executor is not None:
+                    # overlap the round with further local steps; delta-rule
+                    # averaging makes those concurrent steps survive the merge
+                    if self._pending_update is None or self._pending_update.done():
+                        self._finish_pending_update()
+                        self._pending_update = self._update_executor.submit(
+                            self.state_averager.do_averaging_round,
+                            timeout=self.averaging_timeout,
+                            scheduled_time=get_dht_time() + self.matchmaking_time,
+                        )
+                else:
+                    self.state_averager.do_averaging_round(
+                        timeout=self.averaging_timeout,
+                        scheduled_time=get_dht_time() + self.matchmaking_time,
+                    )
             self.tracker.update_epoch(self.local_epoch)
         return self.state_averager.params
 

@@ -229,3 +229,60 @@ def test_one_epoch_grace_reload_rule():
         if opt is not None:
             opt.shutdown()
         dht.shutdown()
+
+
+def test_local_updates_with_delayed_state_averaging():
+    """The canonical local-SGD combination: use_local_updates + delay_state_averaging
+    + delta_rule_averaging. State rounds run on the background thread while local
+    steps continue; peers converge and stay in sync."""
+    features, targets, loss_and_grad = _toy_problem(seed=4)
+    dhts = launch_dht_swarm(2)
+    results, errors = {}, []
+
+    def run_peer(index: int, dht: DHT):
+        try:
+            params = {"w": jnp.zeros(8, jnp.float32)}
+            opt = Optimizer(
+                dht=dht, run_id="localsgd", target_batch_size=64,
+                params=params, optimizer=optax.sgd(0.2),
+                batch_size_per_step=16, matchmaking_time=1.5, averaging_timeout=30,
+                average_state_every=1, target_group_size=2,
+                use_local_updates=True, delay_state_averaging=True, delta_rule_averaging=True,
+                tracker_opts=dict(min_refresh_period=0.3, default_refresh_period=0.5),
+            )
+            rng_local = np.random.RandomState(index)
+            first_loss = last_loss = None
+            for _ in range(80):
+                if opt.local_epoch >= 4:
+                    break
+                idx = rng_local.choice(len(features), 16)
+                loss, grads = loss_and_grad(opt.params, features[idx], targets[idx])
+                first_loss = first_loss if first_loss is not None else float(loss)
+                last_loss = float(loss)
+                opt.step(grads)
+                time.sleep(0.25)
+            results[index] = (first_loss, last_loss, opt.local_epoch, np.asarray(opt.params["w"]))
+            opt.shutdown()
+        except Exception:
+            import traceback
+
+            errors.append((index, traceback.format_exc()))
+
+    threads = [threading.Thread(target=run_peer, args=(i, d)) for i, d in enumerate(dhts)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=240)
+    try:
+        assert not errors, f"peer failures: {errors}"
+        assert len(results) == 2
+        for index, (first_loss, last_loss, epoch, _w) in results.items():
+            assert epoch >= 2, f"peer {index} stuck at epoch {epoch}"
+            assert last_loss < first_loss / 5, (
+                f"peer {index}: loss {first_loss:.4f} -> {last_loss:.4f} did not converge"
+            )
+        w0, w1 = results[0][3], results[1][3]
+        assert np.allclose(w0, w1, atol=0.25), f"peers diverged: {np.abs(w0 - w1).max()}"
+    finally:
+        for dht in dhts:
+            dht.shutdown()

@@ -225,3 +225,35 @@ def test_process_wide_key_singleton():
     k1 = Ed25519PrivateKey.process_wide()
     k2 = Ed25519PrivateKey.process_wide()
     assert k1 is k2
+
+
+def test_profiling_hooks():
+    """trace_span/profile_to/StepProfiler: XLA profiler integration + throughput EMA."""
+    import tempfile
+    import jax.numpy as jnp
+    from hivemind_tpu.utils.profiling import (
+        StepProfiler,
+        device_memory_stats,
+        profile_to,
+        trace_span,
+    )
+
+    with tempfile.TemporaryDirectory() as logdir:
+        with profile_to(logdir):
+            with trace_span("test_region"):
+                jnp.ones(8).sum().block_until_ready()
+        import os
+        assert any(os.scandir(logdir)), "profiler wrote no trace"
+
+    stats = device_memory_stats()
+    assert isinstance(stats, dict)  # may be empty on CPU
+
+    prof = StepProfiler(flops_per_token=1e6)
+    for _ in range(5):
+        prof.step(tokens=100)
+    assert prof.total_tokens == 500
+    assert prof.tokens_per_second > 0
+    assert prof.achieved_flops == prof.tokens_per_second * 1e6
+    assert 0 < prof.mfu(1e12) < 1e6
+    summary = prof.summary()
+    assert summary["total_tokens"] == 500 and summary["achieved_tflops"] is not None
