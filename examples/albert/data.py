@@ -1,0 +1,167 @@
+"""Real-data MLM batches for the collaborative ALBERT recipe (fills the reference's
+examples/albert data pipeline role, run_trainer.py + HF datasets/tokenizers).
+
+Two tiers, so the recipe works on air-gapped machines and scales up when the HF
+stack has local assets:
+
+1. :class:`TextMLMDataset` — self-contained: builds a frequency vocabulary from a
+   local text corpus, encodes it into one token stream, and samples BERT-style
+   masked-LM batches (15% selection, 80/10/10 mask/random/keep). Zero downloads.
+   COLLABORATIVE CAVEAT: every peer gradient-averages ONE shared model, so all
+   peers must share one token mapping — either train from the same corpus file or
+   pass ``vocab_path`` pointing at a shared vocab file (written by the first peer,
+   loaded by the rest).
+2. :func:`load_hf_mlm_dataset` — when a HuggingFace tokenizer + dataset are
+   available ON DISK (``datasets.load_from_disk`` / cached tokenizer), use them
+   instead; the tokenizer itself is the shared vocabulary.
+
+Batch schema matches ``hivemind_tpu.models.make_synthetic_mlm_batch``:
+``{"input_ids", "labels", "mlm_mask"}`` with shapes [batch, seq_len]."""
+
+from __future__ import annotations
+
+import collections
+import os
+import re
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+PAD, CLS, SEP, MASK, UNK = 0, 1, 2, 3, 4
+NUM_SPECIAL = 5
+_TOKEN_RE = re.compile(r"[\w']+|[^\w\s]")
+
+
+def _apply_mlm_mask(
+    labels: np.ndarray,
+    selected: np.ndarray,
+    rng: np.random.RandomState,
+    mask_id: int,
+    vocab_size: int,
+) -> np.ndarray:
+    """BERT 80/10/10: of the selected positions, 80% -> [MASK], 10% -> random token,
+    10% -> unchanged; the loss is taken on ALL selected positions."""
+    roll = rng.rand(*labels.shape)
+    input_ids = labels.copy()
+    input_ids[selected & (roll < 0.8)] = mask_id
+    random_positions = selected & (roll >= 0.8) & (roll < 0.9)
+    input_ids[random_positions] = rng.randint(
+        NUM_SPECIAL, vocab_size, size=int(random_positions.sum())
+    )
+    return input_ids
+
+
+class TextMLMDataset:
+    """Masked-LM batches from a local text file. See module docstring."""
+
+    def __init__(
+        self,
+        path: str,
+        vocab_size: int,
+        seq_len: int,
+        mask_prob: float = 0.15,
+        vocab_path: Optional[str] = None,
+    ):
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            text = f.read()
+        words = _TOKEN_RE.findall(text.lower())
+        if not words:
+            raise ValueError(f"corpus {path!r} contains no tokens")
+        if vocab_path is not None and os.path.exists(vocab_path):
+            with open(vocab_path, "r", encoding="utf-8") as f:
+                word_list = [line.rstrip("\n") for line in f if line.rstrip("\n")]
+        else:
+            counts = collections.Counter(words)
+            word_list = [w for w, _ in counts.most_common(vocab_size - NUM_SPECIAL)]
+            if vocab_path is not None:
+                with open(vocab_path, "w", encoding="utf-8") as f:
+                    f.write("\n".join(word_list) + "\n")
+        self.vocab = {w: i + NUM_SPECIAL for i, w in enumerate(word_list[: vocab_size - NUM_SPECIAL])}
+        self.vocab_size = vocab_size
+        self.seq_len = seq_len
+        self.mask_prob = mask_prob
+        self.stream = np.array([self.vocab.get(w, UNK) for w in words], dtype=np.int32)
+        if len(self.stream) < seq_len:
+            self.stream = np.tile(self.stream, seq_len // len(self.stream) + 1)
+
+    def sample_batch(self, rng: np.random.RandomState, batch_size: int) -> Dict[str, np.ndarray]:
+        starts = rng.randint(0, len(self.stream) - self.seq_len + 1, size=batch_size)
+        labels = np.stack([self.stream[s : s + self.seq_len] for s in starts])
+        selected = rng.rand(batch_size, self.seq_len) < self.mask_prob
+        input_ids = _apply_mlm_mask(labels, selected, rng, MASK, self.vocab_size)
+        return {"input_ids": input_ids, "labels": labels, "mlm_mask": selected}
+
+
+def load_hf_mlm_dataset(
+    dataset_path: str, tokenizer_name: str, vocab_size: int, seq_len: int
+) -> "HFMLMDataset":
+    """Local-disk HuggingFace pipeline (no downloads: load_from_disk + cached
+    tokenizer). Raises ImportError/OSError when the assets are not available."""
+    from datasets import load_from_disk
+    from transformers import AutoTokenizer
+
+    tokenizer = AutoTokenizer.from_pretrained(tokenizer_name, local_files_only=True)
+    dataset = load_from_disk(dataset_path)
+    return HFMLMDataset(dataset, tokenizer, vocab_size, seq_len)
+
+
+class HFMLMDataset:
+    def __init__(self, dataset, tokenizer, vocab_size: int, seq_len: int, mask_prob: float = 0.15):
+        if hasattr(dataset, "keys") and not hasattr(dataset, "features"):
+            # a DatasetDict of splits: train on its training split
+            split = "train" if "train" in dataset else next(iter(dataset))
+            dataset = dataset[split]
+        if len(tokenizer) > vocab_size:
+            raise ValueError(
+                f"tokenizer {tokenizer.name_or_path!r} has {len(tokenizer)} tokens but the "
+                f"model vocab_size is {vocab_size}; configure the model with "
+                f"vocab_size >= {len(tokenizer)} (silently clamping ids would corrupt labels)"
+            )
+        self.dataset, self.tokenizer = dataset, tokenizer
+        self.vocab_size, self.seq_len, self.mask_prob = vocab_size, seq_len, mask_prob
+        self.mask_id = tokenizer.mask_token_id if tokenizer.mask_token_id is not None else MASK
+        self.text_column = "text" if "text" in dataset.column_names else dataset.column_names[0]
+
+    def sample_batch(self, rng: np.random.RandomState, batch_size: int) -> Dict[str, np.ndarray]:
+        rows = rng.randint(0, len(self.dataset), size=batch_size)
+        texts: List[str] = [self.dataset[int(r)][self.text_column] or " " for r in rows]
+        encoded = self.tokenizer(
+            texts, max_length=self.seq_len, truncation=True, padding="max_length",
+            return_tensors="np",
+        )
+        labels = encoded["input_ids"].astype(np.int32)
+        attention = encoded["attention_mask"].astype(bool)
+        selected = (rng.rand(*labels.shape) < self.mask_prob) & attention
+        input_ids = _apply_mlm_mask(labels, selected, rng, self.mask_id, self.vocab_size)
+        return {"input_ids": input_ids, "labels": labels, "mlm_mask": selected}
+
+
+def make_batch_sampler(
+    config,
+    seq_len: int,
+    dataset_path: Optional[str] = None,
+    hf_tokenizer: Optional[str] = None,
+    vocab_path: Optional[str] = None,
+    seed: int = 0,
+) -> Callable[[int], Dict]:
+    """The trainer's data entrypoint: real corpus when given, synthetic otherwise.
+    The synthetic sampler returns device (jnp) arrays — no host round trip."""
+    if hf_tokenizer is not None and dataset_path is None:
+        raise ValueError("--hf_tokenizer requires --dataset_path (an on-disk HF dataset dir)")
+    rng = np.random.RandomState(seed)
+    if dataset_path is not None and hf_tokenizer is not None:
+        dataset = load_hf_mlm_dataset(dataset_path, hf_tokenizer, config.vocab_size, seq_len)
+        return lambda batch_size: dataset.sample_batch(rng, batch_size)
+    if dataset_path is not None:
+        dataset = TextMLMDataset(dataset_path, config.vocab_size, seq_len, vocab_path=vocab_path)
+        return lambda batch_size: dataset.sample_batch(rng, batch_size)
+
+    import jax
+
+    from hivemind_tpu.models import make_synthetic_mlm_batch
+
+    def synthetic(batch_size: int):
+        key = jax.random.PRNGKey(rng.randint(0, 2**31 - 1))
+        return make_synthetic_mlm_batch(key, config, batch_size, seq_len)
+
+    return synthetic

@@ -3,8 +3,11 @@ examples/albert/run_trainer.py — the flagship recipe: every peer runs this scr
 joins the swarm via the DHT, and trains one shared ALBERT with the collaborative
 Optimizer; peers may come and go at any time).
 
-Trains on synthetic MLM data so the recipe runs anywhere (real-data wiring via
-HuggingFace datasets is a round-2 item, see docs/design_notes.md)."""
+Data: pass ``--dataset_path corpus.txt`` to train on a real local corpus (see
+examples/albert/data.py — self-contained tokenizer, BERT-style 80/10/10 masking;
+add ``--hf_tokenizer <name>`` to use an on-disk HuggingFace dataset + cached
+tokenizer instead). Without it, synthetic MLM data keeps the recipe runnable
+anywhere."""
 
 from __future__ import annotations
 
@@ -29,9 +32,19 @@ def main():
     parser.add_argument("--client_mode", action="store_true")
     parser.add_argument("--tiny", action="store_true", help="albert-tiny config (CPU-friendly)")
     parser.add_argument("--powersgd_rank", type=int, default=0, help=">0: PowerSGD gradient compression")
+    parser.add_argument("--dataset_path", default=None, help="local text corpus (or HF dataset dir with --hf_tokenizer)")
+    parser.add_argument("--hf_tokenizer", default=None, help="cached HuggingFace tokenizer name for --dataset_path")
+    parser.add_argument("--vocab_path", default=None,
+                        help="shared vocab file for text corpora: ALL peers must use the same token "
+                             "mapping (first peer writes it, the rest load it)")
+    parser.add_argument("--seed", type=int, default=None, help="data sampling seed (default: random per peer)")
+    parser.add_argument("--platform", default=None, help="force a jax platform (e.g. cpu, tpu)")
     args = parser.parse_args()
 
     import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
     import jax.numpy as jnp
     import optax
 
@@ -85,12 +98,21 @@ def main():
         verbose=True,
     )
 
-    rng = jax.random.PRNGKey(int(time.time() * 1000) % 2**31)
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from data import make_batch_sampler
+
+    sample_batch = make_batch_sampler(
+        config, args.seq_len, dataset_path=args.dataset_path,
+        hf_tokenizer=args.hf_tokenizer, vocab_path=args.vocab_path,
+        seed=args.seed if args.seed is not None else int(time.time() * 1000) % 2**31,
+    )
     step = 0
     loss_ema = None
     while step < args.max_steps:
-        rng, batch_rng = jax.random.split(rng)
-        batch = make_synthetic_mlm_batch(batch_rng, config, args.batch_size, args.seq_len)
+        batch = {k: jnp.asarray(v) for k, v in sample_batch(args.batch_size).items()}
         loss, grads = loss_and_grad(opt.params, batch)
         opt.step(grads)
         loss_value = float(loss)

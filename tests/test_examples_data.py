@@ -1,0 +1,80 @@
+"""examples/albert data pipeline: self-contained corpus tokenizer + BERT-style
+masking statistics, and the sampler fallback chain."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples", "albert"))
+
+from data import MASK, NUM_SPECIAL, TextMLMDataset, make_batch_sampler  # noqa: E402
+
+
+@pytest.fixture
+def corpus(tmp_path):
+    path = tmp_path / "corpus.txt"
+    words = ["alpha", "beta", "gamma", "delta", "epsilon"] * 400
+    rng = np.random.RandomState(0)
+    rng.shuffle(words)
+    path.write_text(" ".join(words))
+    return str(path)
+
+
+def test_text_mlm_dataset_masking(corpus):
+    dataset = TextMLMDataset(corpus, vocab_size=64, seq_len=32, mask_prob=0.15)
+    rng = np.random.RandomState(1)
+    batch = dataset.sample_batch(rng, batch_size=64)
+    assert batch["input_ids"].shape == batch["labels"].shape == batch["mlm_mask"].shape == (64, 32)
+    assert batch["labels"].min() >= NUM_SPECIAL  # only real words in this corpus
+    assert batch["labels"].max() < 64
+
+    # unselected positions are untouched
+    untouched = ~batch["mlm_mask"]
+    np.testing.assert_array_equal(batch["input_ids"][untouched], batch["labels"][untouched])
+
+    # BERT 80/10/10: ~80% of selected positions are [MASK]; ~15% selected overall
+    selected = batch["mlm_mask"]
+    rate = selected.mean()
+    assert 0.10 < rate < 0.20, rate
+    mask_fraction = (batch["input_ids"][selected] == MASK).mean()
+    assert 0.7 < mask_fraction < 0.9, mask_fraction
+    # and some positions differ from the label without being [MASK] (random 10%)
+    changed = (batch["input_ids"] != batch["labels"]) & selected & (batch["input_ids"] != MASK)
+    assert changed.sum() > 0
+
+
+def test_make_batch_sampler_chain(corpus):
+    from hivemind_tpu.models import AlbertConfig
+
+    config = AlbertConfig.tiny(max_position=32)
+    real = make_batch_sampler(config, seq_len=32, dataset_path=corpus, seed=3)
+    batch = real(8)
+    assert batch["input_ids"].shape == (8, 32)
+
+    synthetic = make_batch_sampler(config, seq_len=32, seed=3)
+    batch = synthetic(4)
+    assert batch["input_ids"].shape == (4, 32)
+    assert set(batch) == {"input_ids", "labels", "mlm_mask"}
+
+
+def test_shared_vocab_across_peers(tmp_path, corpus):
+    """Two peers with DIFFERENT corpora get an identical token mapping through the
+    shared vocab file (the collaborative-training requirement)."""
+    vocab_path = str(tmp_path / "vocab.txt")
+    first = TextMLMDataset(corpus, vocab_size=64, seq_len=16, vocab_path=vocab_path)
+
+    other_corpus = tmp_path / "other.txt"
+    other_corpus.write_text("gamma beta zeta " * 200)  # different corpus, different stats
+    second = TextMLMDataset(str(other_corpus), vocab_size=64, seq_len=16, vocab_path=vocab_path)
+    assert first.vocab == second.vocab  # mapping came from the shared file
+
+    import pytest as _pytest
+
+    from data import make_batch_sampler
+
+    with _pytest.raises(ValueError, match="hf_tokenizer"):
+        from hivemind_tpu.models import AlbertConfig
+
+        make_batch_sampler(AlbertConfig.tiny(max_position=16), 16, hf_tokenizer="bert-base-uncased")
