@@ -49,7 +49,7 @@ def main():
     import optax
 
     from hivemind_tpu.dht import DHT
-    from hivemind_tpu.models import AlbertConfig, AlbertForMaskedLM, make_synthetic_mlm_batch, mlm_loss
+    from hivemind_tpu.models import AlbertConfig, AlbertForMaskedLM, make_mlm_loss_fn, make_synthetic_mlm_batch
     from hivemind_tpu.optim import Optimizer
     from hivemind_tpu.utils.logging import get_logger
 
@@ -64,13 +64,12 @@ def main():
     sample = make_synthetic_mlm_batch(jax.random.PRNGKey(0), config, args.batch_size, args.seq_len)
     params = model.init(jax.random.PRNGKey(0), sample["input_ids"][:1, :8])["params"]
 
+    # masked-only loss: ~4x cheaper MLM head (same objective at 15% masking)
+    loss_fn = make_mlm_loss_fn(model, masked_loss_fraction=0.25)
+
     @jax.jit
     def loss_and_grad(params, batch):
-        def fn(p):
-            logits = model.apply({"params": p}, batch["input_ids"])
-            return mlm_loss(logits, batch["labels"], batch["mlm_mask"])
-
-        return jax.value_and_grad(fn)(params)
+        return jax.value_and_grad(loss_fn)(params, batch)
 
     grad_averager_factory = None
     grad_averager_opts = {}

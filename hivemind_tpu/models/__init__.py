@@ -3,6 +3,7 @@ from hivemind_tpu.models.albert import (
     AlbertForMaskedLM,
     AlbertLayer,
     make_synthetic_mlm_batch,
+    make_mlm_loss_fn,
     make_train_step,
     mlm_loss,
 )

@@ -128,12 +128,38 @@ class AlbertForMaskedLM(nn.Module):
             x = self.shared_layer(x, attention_mask)
         return x
 
-    def __call__(self, input_ids: jax.Array, attention_mask: Optional[jax.Array] = None) -> jax.Array:
-        """Returns MLM logits [batch, seq, vocab] (float32 for a stable softmax)."""
-        hidden = self.encode(input_ids, attention_mask)
+    def _mlm_logits(self, hidden: jax.Array) -> jax.Array:
         transformed = self.mlm_norm(jax.nn.gelu(self.mlm_transform(hidden)))
         logits = self.word_embeddings.attend(transformed)  # tied decoder
         return logits.astype(jnp.float32) + self.mlm_bias
+
+    def __call__(self, input_ids: jax.Array, attention_mask: Optional[jax.Array] = None) -> jax.Array:
+        """Returns MLM logits [batch, seq, vocab] (float32 for a stable softmax)."""
+        return self._mlm_logits(self.encode(input_ids, attention_mask))
+
+    def loss_masked_only(
+        self, input_ids: jax.Array, labels: jax.Array, mlm_mask: jax.Array, budget: int
+    ) -> jax.Array:
+        """MLM loss computed ONLY at masked positions (up to ``budget`` per row).
+
+        The full-logits path materializes fp32 [batch, seq, vocab] — ~2 GB at
+        batch 32 × seq 512 × vocab 30k — yet only ~15% of positions carry loss.
+        Gathering those positions first shrinks the decoder matmul and the softmax
+        by seq/budget (≈4× at budget=seq/4) in both passes: the single biggest
+        single-chip throughput lever for this model. ``budget`` must be static
+        (XLA shapes); rows with more masked positions than the budget contribute
+        their first ``budget`` ones (at 15% masking, budget seq/4 is ≈ +6σ above
+        the binomial mean, so truncation is virtually never hit)."""
+        hidden = self.encode(input_ids)
+        order = jnp.argsort(~mlm_mask, axis=1, stable=True)[:, :budget]  # masked first
+        selected_mask = jnp.take_along_axis(mlm_mask, order, axis=1)
+        selected_hidden = jnp.take_along_axis(hidden, order[..., None], axis=1)
+        selected_labels = jnp.take_along_axis(labels, order, axis=1)
+        logits = self._mlm_logits(selected_hidden)  # [batch, budget, vocab]
+        log_probs = jax.nn.log_softmax(logits, axis=-1)
+        label_ll = jnp.take_along_axis(log_probs, selected_labels[..., None], axis=-1)[..., 0]
+        mask = selected_mask.astype(jnp.float32)
+        return -(label_ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
 
 
 def mlm_loss(logits: jax.Array, labels: jax.Array, mlm_mask: jax.Array) -> jax.Array:
@@ -144,19 +170,40 @@ def mlm_loss(logits: jax.Array, labels: jax.Array, mlm_mask: jax.Array) -> jax.A
     return -(label_ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
 
 
-def make_train_step(config: AlbertConfig, optimizer):
+def make_mlm_loss_fn(model: "AlbertForMaskedLM", masked_loss_fraction: Optional[float] = None):
+    """``loss(params, batch) -> scalar`` for dict(input_ids, labels, mlm_mask).
+
+    :param masked_loss_fraction: compute the MLM head only on this fraction of
+        positions per row (the masked ones — see ``loss_masked_only``). Opt-in:
+        rows with more masked positions than ``fraction * seq`` contribute only
+        the first that many, so callers must size it above their masking rate
+        (0.25 gives ≈+6σ headroom over 15% masking at seq 512). None = exact
+        full-logits objective."""
+
+    def loss_fn(params, batch):
+        if masked_loss_fraction is not None:
+            budget = max(1, int(batch["input_ids"].shape[1] * masked_loss_fraction))
+            return model.apply(
+                {"params": params}, batch["input_ids"], batch["labels"], batch["mlm_mask"],
+                budget, method=AlbertForMaskedLM.loss_masked_only,
+            )
+        logits = model.apply({"params": params}, batch["input_ids"])
+        return mlm_loss(logits, batch["labels"], batch["mlm_mask"])
+
+    return loss_fn
+
+
+def make_train_step(config: AlbertConfig, optimizer, masked_loss_fraction: Optional[float] = None):
     """A jittable (params, opt_state, batch) -> (loss, params, opt_state) step.
-    ``batch``: dict(input_ids, labels, mlm_mask)."""
+    ``batch``: dict(input_ids, labels, mlm_mask). See ``make_mlm_loss_fn`` for
+    ``masked_loss_fraction`` (None keeps the exact full-logits objective)."""
     import optax
 
     model = AlbertForMaskedLM(config)
+    loss_fn = make_mlm_loss_fn(model, masked_loss_fraction)
 
     def train_step(params, opt_state, batch):
-        def loss_fn(p):
-            logits = model.apply({"params": p}, batch["input_ids"])
-            return mlm_loss(logits, batch["labels"], batch["mlm_mask"])
-
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return loss, params, opt_state

@@ -93,3 +93,42 @@ def test_sharded_training_step_8_devices():
     # tp sharding actually applied to attention kernels
     q_kernel = new_params["shared_layer"]["query"]["kernel"]
     assert "tp" in str(q_kernel.sharding.spec)
+
+
+def test_masked_only_loss_equals_full_loss():
+    """loss_masked_only with a sufficient budget equals the full-logits mlm_loss
+    (the bench's throughput lever must not change the objective)."""
+    from hivemind_tpu.models import AlbertConfig, AlbertForMaskedLM, make_synthetic_mlm_batch, mlm_loss
+
+    config = AlbertConfig.tiny(max_position=64)
+    model = AlbertForMaskedLM(config)
+    batch = make_synthetic_mlm_batch(jax.random.PRNGKey(0), config, 4, 64)
+    params = model.init(jax.random.PRNGKey(1), batch["input_ids"][:1, :8])["params"]
+
+    full = mlm_loss(
+        model.apply({"params": params}, batch["input_ids"]), batch["labels"], batch["mlm_mask"]
+    )
+    masked = model.apply(
+        {"params": params}, batch["input_ids"], batch["labels"], batch["mlm_mask"], 32,
+        method=AlbertForMaskedLM.loss_masked_only,
+    )
+    np.testing.assert_allclose(float(masked), float(full), rtol=1e-5)
+
+    # gradients agree too (the actual training signal), across EVERY parameter
+    import optax
+    from hivemind_tpu.models import make_train_step
+
+    updated = {}
+    for fraction in (0.5, None):
+        _model, step = make_train_step(config, optax.sgd(0.1), masked_loss_fraction=fraction)
+        opt_state = optax.sgd(0.1).init(params)
+        loss, new_params, _ = jax.jit(step)(params, opt_state, batch)
+        updated[fraction] = new_params
+    for masked_leaf, full_leaf in zip(
+        jax.tree_util.tree_leaves(updated[0.5]), jax.tree_util.tree_leaves(updated[None])
+    ):
+        # bf16 compute: gathering positions before the head reorders reductions,
+        # so per-element grads differ by bf16 noise (~1% rel), not exactly
+        np.testing.assert_allclose(
+            np.asarray(masked_leaf), np.asarray(full_leaf), rtol=0.05, atol=1e-4
+        )
