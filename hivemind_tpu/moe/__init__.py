@@ -3,6 +3,7 @@ from hivemind_tpu.moe.client import (
     RemoteExpert,
     RemoteExpertWorker,
     RemoteMixtureOfExperts,
+    RemoteSequential,
     RemoteSwitchMixtureOfExperts,
 )
 from hivemind_tpu.moe.expert_uid import ExpertInfo, ExpertUID, is_valid_prefix, is_valid_uid, split_uid

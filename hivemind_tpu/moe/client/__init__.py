@@ -1,3 +1,4 @@
 from hivemind_tpu.moe.client.beam_search import MoEBeamSearcher
 from hivemind_tpu.moe.client.expert import RemoteExpert, RemoteExpertWorker
 from hivemind_tpu.moe.client.moe import RemoteMixtureOfExperts, RemoteSwitchMixtureOfExperts
+from hivemind_tpu.moe.client.remote_sequential import RemoteSequential

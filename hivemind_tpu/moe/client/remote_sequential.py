@@ -1,0 +1,145 @@
+"""RemoteSequential: run a model as a CHAIN of remote transformer blocks served by
+swarm peers — pipelined model parallelism over the DHT (the Petals-style capability
+layered on the DMoE stack; the reference README positions Petals as the downstream
+project built exactly this way on hivemind, README.md:35-40, and SURVEY §7.10 lists
+it as the capability layer above the expert server).
+
+Blocks are ordinary experts named ``{prefix}{index}`` ("gpt_block.0", "gpt_block.1",
+…): any :class:`hivemind_tpu.moe.Server` can host any subset of blocks and declares
+them in the DHT. The client resolves each index lazily, chains the blocks'
+``RemoteExpert`` calls — each differentiable via custom_vjp — so ``jax.grad`` flows
+through the WHOLE pipeline, and every backward RPC also trains the server-side block
+(ModuleBackend on_backward semantics). A failed block call triggers re-resolution
+(a replacement server re-declaring the same uid takes over transparently)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import jax
+
+from hivemind_tpu.dht import DHT
+from hivemind_tpu.moe.client.expert import RemoteExpert
+from hivemind_tpu.moe.expert_uid import ExpertInfo
+from hivemind_tpu.moe.server.dht_handler import get_experts
+from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.loop import get_loop_runner
+
+logger = get_logger(__name__)
+
+
+class _ResilientBlock(RemoteExpert):
+    """A RemoteExpert whose RPCs retry with DHT re-resolution INSIDE forward_np /
+    backward_np — i.e. inside the pure_callback — so failover covers the backward
+    pass of jax.grad and jitted execution, not just the eager forward dispatch."""
+
+    def __init__(self, sequential: "RemoteSequential", index: int, info: ExpertInfo):
+        super().__init__(info, sequential.p2p)
+        self._sequential = sequential
+        self._index = index
+
+    def _with_retries(self, operation):
+        last_error: Optional[Exception] = None
+        for attempt in range(self._sequential.max_retries + 1):
+            if attempt:
+                fresh = self._sequential._resolve_info(self._index, force=True)
+                self.expert_info = fresh
+                with self._info_lock:
+                    self._info = None  # schema may differ on the new server
+            try:
+                return operation()
+            except Exception as e:
+                last_error = e
+                logger.warning(
+                    f"block {self.uid} via {self.peer_id} failed (attempt {attempt + 1}): {e!r}"
+                )
+        raise RuntimeError(f"block {self.uid} failed after retries") from last_error
+
+    def forward_np(self, *xs):
+        return self._with_retries(lambda: RemoteExpert.forward_np(self, *xs))
+
+    def backward_np(self, *tensors):
+        return self._with_retries(lambda: RemoteExpert.backward_np(self, *tensors))
+
+    @property
+    def info(self):
+        # the schema fetch at dispatch time must fail over too
+        return self._with_retries(lambda: RemoteExpert.info.fget(self))
+
+
+class RemoteSequential:
+    """See module docstring.
+
+    :param prefix: block uid prefix incl. trailing delimiter, e.g. ``"gpt_block."``
+    :param num_blocks: pipeline depth; block i is expert ``{prefix}{i}``
+    :param update_period: re-resolve a cached block after this many seconds
+    :param max_retries: per block call: failures before giving up (each retry
+        re-resolves the uid from the DHT first)
+    """
+
+    def __init__(
+        self,
+        dht: DHT,
+        prefix: str,
+        num_blocks: int,
+        *,
+        update_period: float = 30.0,
+        max_retries: int = 2,
+    ):
+        self.dht, self.prefix, self.num_blocks = dht, prefix, num_blocks
+        self.update_period, self.max_retries = update_period, max_retries
+        self.p2p = get_loop_runner().run_coroutine(dht.replicate_p2p())
+        self._blocks: Dict[int, _ResilientBlock] = {}
+        self._resolved_at: Dict[int, float] = {}
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return self.num_blocks
+
+    def block_uid(self, index: int) -> str:
+        return f"{self.prefix}{index}"
+
+    def _resolve_info(self, index: int, force: bool = False) -> ExpertInfo:
+        with self._lock:
+            fresh_enough = time.monotonic() - self._resolved_at.get(index, -1e9) < self.update_period
+            if not force and index in self._blocks and fresh_enough:
+                return self._blocks[index].expert_info
+        [info] = get_experts(self.dht, [self.block_uid(index)])
+        if info is None:
+            raise RuntimeError(f"no server declares block {self.block_uid(index)!r}")
+        with self._lock:
+            self._resolved_at[index] = time.monotonic()
+        return info
+
+    def _block(self, index: int) -> _ResilientBlock:
+        info = self._resolve_info(index)
+        with self._lock:
+            block = self._blocks.get(index)
+            if block is None:
+                block = self._blocks[index] = _ResilientBlock(self, index, info)
+            elif block.expert_info != info:
+                block.expert_info = info  # route refreshed by update_period
+                with block._info_lock:
+                    block._info = None
+            return block
+
+    def _call_block(self, index: int, x: jax.Array) -> jax.Array:
+        return self._block(index)(x)
+
+    def __call__(self, x: jax.Array, start: int = 0, stop: Optional[int] = None) -> jax.Array:
+        """Run blocks [start, stop) in order; differentiable end to end."""
+        stop = stop if stop is not None else self.num_blocks
+        for index in range(start, stop):
+            x = self._call_block(index, x)
+        return x
+
+    def __getitem__(self, index: int):
+        """A callable handle to one block (e.g. for partial pipelines)."""
+        if not (0 <= index < self.num_blocks):
+            raise IndexError(index)
+        return lambda x: self._call_block(index, x)
+
+    def __repr__(self):
+        return f"RemoteSequential({self.prefix!r}, {self.num_blocks} blocks)"
