@@ -170,3 +170,58 @@ def test_group_bits_rebucketing():
         assert all(len(b) == 2 and set(b) <= {"0", "1"} for b in bits)
     finally:
         shutdown_all(averagers, dhts)
+
+
+def test_oversized_swarm_splits_into_groups():
+    """More peers than target_group_size: matchmaking forms MULTIPLE groups and
+    every peer still completes its round (reference test_averaging grouping
+    scenarios)."""
+    dhts = launch_dht_swarm(6)
+    # min_group_size=3 forbids a 3+2+1 split that would strand the sixth peer:
+    # undersized groups disband and retry (with jitter) until two 3-groups form
+    averagers = make_averagers(dhts, target_group_size=3, min_group_size=3)
+    try:
+        controls = [a.step(gather={"i": i}, wait=False, timeout=60) for i, a in enumerate(averagers)]
+        groups = []
+        for control in controls:
+            result = control.result(timeout=120)
+            assert result is not None
+            assert len(result) == 3
+            groups.append(frozenset(result))
+        # the groups PARTITION the swarm: every peer in exactly one group, and
+        # groupmates agree on the membership
+        distinct = set(groups)
+        assert len(distinct) >= 2, f"six peers cannot fit one group of three: {distinct}"
+        seen = [peer for group in distinct for peer in group]
+        assert len(seen) == 6 and len(set(seen)) == 6, distinct
+    finally:
+        shutdown_all(averagers, dhts)
+
+
+def test_aux_peer_helps_averaging():
+    """An AUX averager (reduces-only, zero weight) joins a round: the NODE peers
+    converge to the mean of THEIR tensors; the aux contributes no values."""
+    dhts = launch_dht_swarm(3)
+    rng = np.random.RandomState(0)
+    values = [rng.randn(200).astype(np.float32) for _ in range(2)]
+    common = dict(
+        prefix="auxavg", start=True, target_group_size=3, min_group_size=3,
+        min_matchmaking_time=1.0, request_timeout=1.0,
+        sender_timeout=5.0, reducer_timeout=10.0,
+    )
+    nodes = [
+        DecentralizedAverager([values[i].copy()], dhts[i], **common) for i in range(2)
+    ]
+    aux = DecentralizedAverager(
+        [np.zeros(200, np.float32)], dhts[2], auxiliary=True, **common
+    )
+    try:
+        controls = [a.step(wait=False, timeout=40) for a in nodes + [aux]]
+        for control in controls:
+            assert control.result(timeout=90) is not None
+        expected = (values[0] + values[1]) / 2  # aux weight 0: not in the average
+        for node in nodes:
+            with node.get_tensors() as tensors:
+                assert np.allclose(tensors[0], expected, atol=1e-4)
+    finally:
+        shutdown_all(nodes + [aux], dhts)

@@ -297,3 +297,49 @@ async def test_mux_rejects_invalid_open_frames():
     finally:
         await client.shutdown()
         await server.shutdown()
+
+
+async def test_many_concurrent_streams_one_connection():
+    """Stress the mux: many interleaved unary + streaming calls share ONE encrypted
+    connection; every response routes to the right stream (race-detection parity:
+    the reference exercises concurrency with real parallel calls)."""
+    server = await P2P.create()
+    client = await P2P.create()
+    try:
+        async def square(request: test_pb2.TestRequest, context: P2PContext) -> test_pb2.TestResponse:
+            await asyncio.sleep(0.001 * (request.number % 7))  # shuffle completion order
+            return test_pb2.TestResponse(number=request.number ** 2)
+
+        async def countdown(request: test_pb2.TestRequest, context: P2PContext):
+            for value in range(request.number, 0, -1):
+                yield test_pb2.TestResponse(number=value)
+
+        await server.add_protobuf_handler("square", square, test_pb2.TestRequest)
+        await server.add_protobuf_handler("countdown", countdown, test_pb2.TestRequest, stream_output=True)
+        await client.connect(server.get_visible_maddrs()[0])
+
+        async def one_unary(i):
+            response = await client.call_protobuf_handler(
+                server.peer_id, "square", test_pb2.TestRequest(number=i), test_pb2.TestResponse
+            )
+            return response.number
+
+        async def one_stream(i):
+            values = []
+            async for response in client.iterate_protobuf_handler(
+                server.peer_id, "countdown", test_pb2.TestRequest(number=i), test_pb2.TestResponse
+            ):
+                values.append(response.number)
+            return values
+
+        unary_results, stream_results = await asyncio.gather(
+            asyncio.gather(*(one_unary(i) for i in range(50))),
+            asyncio.gather(*(one_stream(i) for i in range(1, 11))),
+        )
+        assert list(unary_results) == [i ** 2 for i in range(50)]
+        assert list(stream_results) == [list(range(i, 0, -1)) for i in range(1, 11)]
+        # all of that rode exactly one connection
+        assert len(client._connections) == 1
+    finally:
+        await client.shutdown()
+        await server.shutdown()
