@@ -16,6 +16,8 @@ def main():
     parser.add_argument("--num_peers", type=int, default=16)
     parser.add_argument("--num_keys", type=int, default=200)
     parser.add_argument("--expiration", type=float, default=300.0)
+    parser.add_argument("--batch_size", type=int, default=64,
+                        help="keys per store_many/get_many call (reference benchmarks batch 64)")
     args = parser.parse_args()
 
     import jax
@@ -30,18 +32,38 @@ def main():
     maddrs = [str(m) for m in first.get_visible_maddrs()]
     dhts = [first] + [DHT(initial_peers=maddrs, start=True) for _ in range(args.num_peers - 1)]
 
+    # batched like the reference benchmark (batch 64): one store_many/get_many call
+    # runs the per-key beam searches CONCURRENTLY on the node's event loop
     store_ok = get_ok = 0
+    batches = [list(range(i, min(i + args.batch_size, args.num_keys)))
+               for i in range(0, args.num_keys, args.batch_size)]
+
     start = time.perf_counter()
-    for i in range(args.num_keys):
-        writer = dhts[i % len(dhts)]
-        store_ok += bool(writer.store(f"bench_key_{i}", i, get_dht_time() + args.expiration))
+    for batch_index, batch in enumerate(batches):
+        writer = dhts[batch_index % len(dhts)]
+        expiration = get_dht_time() + args.expiration
+
+        async def _store(_dht, node, batch=batch, expiration=expiration):
+            return await node.store_many(
+                [f"bench_key_{i}" for i in batch], list(batch), expiration
+            )
+
+        result = writer.run_coroutine(_store)
+        store_ok += sum(bool(v) for v in result.values())
     store_time = time.perf_counter() - start
 
     start = time.perf_counter()
-    for i in range(args.num_keys):
-        reader = dhts[(i + 7) % len(dhts)]
-        result = reader.get(f"bench_key_{i}")
-        get_ok += result is not None and result.value == i
+    for batch_index, batch in enumerate(batches):
+        reader = dhts[(batch_index + 7) % len(dhts)]
+
+        async def _get(_dht, node, batch=batch):
+            return await node.get_many([f"bench_key_{i}" for i in batch])
+
+        found = reader.run_coroutine(_get)
+        get_ok += sum(
+            1 for i in batch
+            if found.get(f"bench_key_{i}") is not None and found[f"bench_key_{i}"].value == i
+        )
     get_time = time.perf_counter() - start
 
     print(json.dumps({
