@@ -81,8 +81,13 @@ class RemoteCallMany:
         }
         results: Dict[str, List[np.ndarray]] = {}
         alive_count = [0] * self.batch_size
+        # the straggler deadline opens once every row has at least ONE response
+        # (even under k_min=0, where missing rows merely output zeros instead of
+        # raising — the window must not open on the first completion and abandon
+        # everyone else). A row can only deliver as many responses as it has real
+        # experts, and an empty row is trivially satisfied.
         needed = [
-            min(need_per_sample, sum(e is not None for e in row)) or 1
+            min(max(need_per_sample, 1), sum(e is not None for e in row))
             for row in self.experts_per_sample
         ]
         hard_deadline = get_dht_time() + timeout if timeout is not None else None

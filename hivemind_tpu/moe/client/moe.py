@@ -142,15 +142,32 @@ class RemoteMixtureOfExperts:
 
 class RemoteSwitchMixtureOfExperts(RemoteMixtureOfExperts):
     """Switch-Transformer routing: top-1 expert, multiplicative jitter on inputs to
-    the gate, and a utilization EMA for load-balancing diagnostics (capability
-    parity: reference hivemind/moe/client/switch_moe.py:17-225)."""
+    the gate, grid dropout for load spreading, and a utilization EMA for
+    load-balancing diagnostics (capability parity: reference
+    hivemind/moe/client/switch_moe.py:17-225).
 
-    def __init__(self, *, jitter_eps: float = 1e-2, utilization_alpha: float = 0.01, **kwargs):
+    :param grid_dropout: keep-probability per grid COORDINATE per call; dropped
+        coordinates get -inf gating score so no sample routes to them this batch,
+        forcing exploration across the grid (reference switch_moe.py:46,84-98).
+        1.0 disables dropout."""
+
+    def __init__(
+        self,
+        *,
+        jitter_eps: float = 1e-2,
+        utilization_alpha: float = 0.01,
+        grid_dropout: float = 1.0,
+        **kwargs,
+    ):
         kwargs.setdefault("k_best", 1)
-        kwargs.setdefault("k_min", 1)
+        # reference switch defaults (switch_moe.py:49-51): a token whose expert
+        # fails contributes ZEROS instead of failing the whole batch
+        kwargs.setdefault("k_min", 0)
+        kwargs.setdefault("backward_k_min", 0)
         super().__init__(**kwargs)
         self.jitter_eps = jitter_eps
         self.utilization_alpha = utilization_alpha
+        self.grid_dropout = grid_dropout
         self.grid_utilization = [np.full(size, 1.0 / size, np.float64) for size in self.grid_size]
         self._jitter_rng = np.random.RandomState(self.beam_size)
 
@@ -162,6 +179,21 @@ class RemoteSwitchMixtureOfExperts(RemoteMixtureOfExperts):
         ).astype(np.float32)
         proj = proj if proj is not None else self.proj
         grid_scores = self._split_scores((x * jnp.asarray(noise)) @ proj)
+        if self.grid_dropout < 1.0:
+            keep_masks = [
+                self._jitter_rng.rand(size) < self.grid_dropout for size in self.grid_size
+            ]
+            for dim, mask in enumerate(keep_masks):
+                if not mask.any():
+                    # never drop a whole dimension (that would un-restrict routing
+                    # to arbitrary tie-breaks among -1e9 scores): keep the
+                    # coordinate the gate likes best on this batch
+                    best = int(np.argmax(np.asarray(jnp.mean(grid_scores[dim], axis=0))))
+                    mask[best] = True
+            grid_scores = [
+                jnp.where(jnp.asarray(mask)[None, :], score, -1e9)
+                for score, mask in zip(grid_scores, keep_masks)
+            ]
         chosen = self.beam_searcher.batch_find_best_experts(
             [np.asarray(jax.lax.stop_gradient(s)) for s in grid_scores], self.beam_size
         )

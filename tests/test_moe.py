@@ -368,3 +368,40 @@ def test_remote_sequential_pipeline():
             client_dht.shutdown()
         server_a.shutdown()
         server_a.dht.shutdown()
+
+
+def test_switch_grid_dropout():
+    """grid_dropout masks grid coordinates with -inf gating scores: routing avoids
+    dropped coordinates; dropout 1.0 is a no-op (reference switch_moe.py:84-98)."""
+    server = make_server()
+    try:
+        import time
+        time.sleep(1.0)
+        client_dht = DHT(initial_peers=[str(m) for m in server.dht.get_visible_maddrs()], start=True)
+        switch = RemoteSwitchMixtureOfExperts(
+            dht=client_dht, in_features=HID, grid_size=(2, 2), uid_prefix="ffn_test.",
+            grid_dropout=0.75,
+        )
+        # force a deterministic mask: keep only row 0 (dim 0) and column 1 (dim 1)
+        class _FixedRng:
+            def __init__(self):
+                self.masks = [np.array([0.0, 1.0]), np.array([1.0, 0.0])]  # < 0.75 keeps
+
+            def uniform(self, low, high, size):
+                return np.full(size, 1.0, np.float32)  # no jitter
+
+            def rand(self, size):
+                return self.masks.pop(0)
+
+        switch._jitter_rng = _FixedRng()
+        x = jnp.asarray(np.random.RandomState(3).randn(4, HID), jnp.float32)
+        out = switch(x)
+        assert out.shape == (4, HID) and bool(jnp.isfinite(out).all())
+        # with rows {0} and cols {1} kept, the only routable expert is 0.1
+        utilization_rows, utilization_cols = switch.grid_utilization
+        assert utilization_rows[0] > utilization_rows[1]
+        assert utilization_cols[1] > utilization_cols[0]
+        client_dht.shutdown()
+    finally:
+        server.shutdown()
+        server.dht.shutdown()
