@@ -21,6 +21,9 @@ def main():
     parser.add_argument("--num_rounds", type=int, default=3)
     parser.add_argument("--num_params", type=int, default=1_000_000)
     parser.add_argument("--compression", default="FLOAT16")
+    parser.add_argument("--part_size_bytes", type=int, default=2**19,
+                        help="pre-compression part size (512 KiB reference default; "
+                             "~2 MiB measured 3x faster on loopback, clamped to the mux cap)")
     args = parser.parse_args()
 
     import jax
@@ -45,6 +48,7 @@ def main():
                 tensors, dht, prefix="bench", start=True,
                 target_group_size=args.target_group_size,
                 min_matchmaking_time=2.0, compression=codec,
+                part_size_bytes=args.part_size_bytes,
                 initial_group_bits="" if args.num_peers <= args.target_group_size else "0",
             )
         )

@@ -30,6 +30,12 @@ from hivemind_tpu.utils.timed_storage import get_dht_time
 
 logger = get_logger(__name__)
 
+# largest pre-compression part that still fits one mux message even uncompressed
+# (MAX_MESSAGE_SIZE = 4 MiB minus headroom for tensor metadata + frame header)
+from hivemind_tpu.p2p.mux import MAX_MESSAGE_SIZE
+
+MAX_PART_SIZE_BYTES = MAX_MESSAGE_SIZE - 2**16
+
 
 class AveragingMode(Enum):
     NODE = 0
@@ -62,6 +68,16 @@ class AllReduceRunner:
         reducer_timeout: float = 60.0,
     ):
         self.p2p, self.group_id = p2p, group_id
+        # one part travels as ONE mux message: a part whose wire size exceeded
+        # MAX_MESSAGE_SIZE would kill the stream mid-round and silently degrade
+        # the average. The clamp uses the same formula on every peer, so senders
+        # and reducers (which derive part shapes independently) stay in agreement.
+        if part_size_bytes > MAX_PART_SIZE_BYTES:
+            logger.info(
+                f"part_size_bytes={part_size_bytes} exceeds the per-message cap; "
+                f"using {MAX_PART_SIZE_BYTES}"
+            )
+            part_size_bytes = MAX_PART_SIZE_BYTES
         self.ordered_peer_ids = tuple(ordered_peer_ids)
         self.modes = tuple(modes)
         self.peer_element_counts = tuple(peer_element_counts)

@@ -59,6 +59,12 @@ class MeshAverager(DecentralizedAverager):
         self.local_reduce_axis = local_reduce_axis
         self._device_tree = device_tree
         self._tree_lock = threading.Lock()
+        # one mesh = one logical peer, so its advertised bandwidth to the LP load
+        # balancer is the slice's AGGREGATE egress (SURVEY §5: a slice's swarm
+        # bandwidth scales with its HOST count), unless the caller overrides it
+        if kwargs.get("bandwidth") is None:
+            num_hosts = len({device.process_index for device in mesh.devices.flat})
+            kwargs["bandwidth"] = 1.0e8 * max(num_hosts, 1)
         host_tensors = self.bridge.gather_to_host(self._reduced_tree(device_tree))
         super().__init__(host_tensors, dht, **kwargs)
 

@@ -74,6 +74,18 @@ class Runtime:
                     f"{samples / max(seconds, 1e-9):.0f} samples/s device time"
                 )
         self._stats.clear()
+        try:
+            from hivemind_tpu.utils.profiling import device_memory_stats
+
+            memory = device_memory_stats()
+            if memory.get("bytes_in_use"):
+                used, limit = memory["bytes_in_use"], memory.get("bytes_limit", 0)
+                logger.info(
+                    f"[device] HBM {used / 2**30:.2f} GiB in use"
+                    + (f" / {limit / 2**30:.2f} GiB" if limit else "")
+                )
+        except Exception:
+            pass  # CPU backends expose no memory stats
 
     def shutdown(self) -> None:
         if self._task is not None:
