@@ -158,7 +158,8 @@ class ProgressTracker:
         """Update the local record and wake the reporter
         (reference progress_tracker.py:153-168)."""
         with self._lock:
-            extra_samples = samples_accumulated - self.local_progress.samples_accumulated
+            previous_local_samples = self.local_progress.samples_accumulated
+            extra_samples = samples_accumulated - previous_local_samples
             if update_ema and extra_samples > 0:
                 if self.performance_ema.paused:
                     self.performance_ema.paused = False
@@ -174,6 +175,17 @@ class ProgressTracker:
                 client_mode=self.client_mode,
             )
         self._wake_reporter()
+        # our own progress may be what completes the epoch (always true for small
+        # swarms): re-aggregate NOW instead of sleeping out the adaptive refresh —
+        # otherwise a lone peer stalls for max_refresh_period after every report.
+        # The snapshot already counts our PREVIOUS contribution: subtract it, or
+        # every tail-of-epoch report would re-wake the fetcher (a fetch storm)
+        global_snapshot = self.global_progress
+        remote_samples = max(global_snapshot.samples_accumulated - previous_local_samples, 0)
+        if not global_snapshot.ready_to_update_epoch and (
+            samples_accumulated + remote_samples >= global_snapshot.target_batch_size
+        ):
+            self._wake_fetcher()
 
     def update_epoch(self, new_epoch: int) -> None:
         with self._lock:
@@ -243,8 +255,10 @@ class ProgressTracker:
                     continue
         with self._lock:
             local = self.local_progress
-        if not any(r.peer_id == local.peer_id for r in records):
-            records.append(local)
+        # the in-memory record is always fresher than the DHT's copy of ourselves
+        # (the reporter may not have re-stored yet): never aggregate a stale self
+        records = [r for r in records if r.peer_id != local.peer_id]
+        records.append(local)
 
         global_epoch = max((r.epoch for r in records), default=local.epoch)
         samples = sum(r.samples_accumulated for r in records if r.epoch == global_epoch)

@@ -186,3 +186,25 @@ def test_optimizer_collaborative_convergence():
     finally:
         for dht in dhts:
             dht.shutdown()
+
+
+def test_single_peer_epoch_progress():
+    """A LONE peer's own report completes the epoch: readiness must arrive within
+    ~a second, not after max_refresh_period (regression: the fetcher slept out its
+    adaptive refresh while the local report already crossed the target, and stale
+    self-records in the DHT shadowed fresh local progress)."""
+    dht = DHT(start=True)
+    tracker = None
+    try:
+        tracker = ProgressTracker(dht, "solo_run", target_batch_size=16,
+                                  min_refresh_period=0.2, default_refresh_period=0.3)
+        tracker.report_local_progress(0, 16)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not tracker.ready_to_update_epoch:
+            time.sleep(0.1)
+        assert tracker.ready_to_update_epoch, tracker.global_progress
+        assert tracker.global_progress.samples_accumulated >= 16
+    finally:
+        if tracker is not None:
+            tracker.shutdown()
+        dht.shutdown()

@@ -1,0 +1,70 @@
+"""End-to-end training with remote experts in the model (scope: reference
+tests/test_training.py — a model whose middle layer is a RemoteExpert trains through
+the RPC boundary with the collaborative Optimizer; the server-side expert trains
+itself on every backward call)."""
+
+import time
+
+import numpy as np
+import optax
+
+import jax
+import jax.numpy as jnp
+
+from hivemind_tpu.dht import DHT
+from hivemind_tpu.moe import ExpertInfo, RemoteExpert, background_server
+from hivemind_tpu.optim import Optimizer
+
+HID = 16
+
+
+def test_training_through_remote_expert():
+    with background_server(
+        expert_uids=["train_ffn.0"], expert_cls="ffn", hidden_dim=HID,
+        optim_factory=lambda: optax.adam(1e-3),
+    ) as (server_dht, server):
+        client_dht = DHT(initial_peers=[str(m) for m in server_dht.get_visible_maddrs()], start=True)
+        opt = None
+        try:
+            time.sleep(0.5)
+            expert = RemoteExpert(ExpertInfo("train_ffn.0", server_dht.peer_id), client_dht.node.p2p)
+
+            rng = np.random.RandomState(0)
+            features = rng.randn(128, HID).astype(np.float32)
+            true_w = rng.randn(HID).astype(np.float32)
+            targets = features @ true_w
+
+            params = {
+                "w_in": jnp.asarray(rng.randn(HID, HID) * 0.3, jnp.float32),
+                "w_out": jnp.asarray(rng.randn(HID) * 0.3, jnp.float32),
+            }
+
+            def loss_fn(p, x, y):
+                hidden = jnp.tanh(x @ p["w_in"])
+                hidden = expert(hidden)  # RPC in the middle of the model
+                prediction = hidden @ p["w_out"]
+                return jnp.mean((prediction - y) ** 2)
+
+            loss_and_grad = jax.value_and_grad(loss_fn)
+            opt = Optimizer(
+                dht=client_dht, run_id="train_e2e", target_batch_size=16,
+                params=params, optimizer=optax.adam(2e-2), batch_size_per_step=16,
+                matchmaking_time=1.0,
+                tracker_opts=dict(min_refresh_period=0.2, default_refresh_period=0.3),
+            )
+            first_loss = last_loss = None
+            for step in range(30):
+                idx = rng.choice(len(features), 16)
+                loss, grads = loss_and_grad(opt.params, features[idx], targets[idx])
+                first_loss = first_loss if first_loss is not None else float(loss)
+                last_loss = float(loss)
+                opt.step(grads)
+                time.sleep(0.1)
+            assert last_loss < first_loss / 2, (first_loss, last_loss)
+            assert opt.local_epoch >= 2  # epochs advanced (single-peer local grads)
+            # the server-side expert trained too: one update per backward RPC
+            assert server.backends["train_ffn.0"].update_count >= 10
+        finally:
+            if opt is not None:
+                opt.shutdown()
+            client_dht.shutdown()
