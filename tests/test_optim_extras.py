@@ -1,10 +1,13 @@
 """PowerSGD averaging (two chained phases, error feedback), GradScaler shim,
 TrainingAverager legacy, math utils."""
 
+import time
+
 import numpy as np
 import optax
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from hivemind_tpu.dht import DHT
@@ -123,6 +126,71 @@ def test_training_averager_legacy():
             assert np.allclose(states[i]["params"][0], 1.5, atol=1e-4)
         for a in averagers:
             a.shutdown()
+    finally:
+        for dht in dhts:
+            dht.shutdown()
+
+
+def test_optimizer_with_powersgd_factory():
+    """The collaborative Optimizer with PowerSGD gradient compression (the albert
+    recipe's --powersgd_rank path): two peers converge through low-rank averaged
+    gradients (scope: reference test_optimizer.py grad_averager_factory case)."""
+    import threading
+
+    from hivemind_tpu.optim import Optimizer, PowerSGDGradientAverager
+
+    rng = np.random.RandomState(0)
+    true_w = rng.randn(8, 4).astype(np.float32)
+    features = rng.randn(256, 8).astype(np.float32)
+    targets = features @ true_w
+
+    @jax.jit
+    def loss_and_grad(params, x, y):
+        return jax.value_and_grad(lambda p: jnp.mean((x @ p["w"] - y) ** 2))(params)
+
+    dhts = launch_dht_swarm(2)
+    results, errors = {}, []
+
+    def run_peer(index, dht):
+        try:
+            opt = Optimizer(
+                dht=dht, run_id="powersgd_opt", target_batch_size=64,
+                params={"w": jnp.zeros((8, 4), jnp.float32)}, optimizer=optax.sgd(0.3),
+                batch_size_per_step=16, matchmaking_time=1.5, averaging_timeout=30,
+                target_group_size=2,
+                grad_averager_factory=PowerSGDGradientAverager,
+                grad_averager_opts={"averager_rank": 2},
+                tracker_opts=dict(min_refresh_period=0.3, default_refresh_period=0.5),
+            )
+            rng_local = np.random.RandomState(index)
+            first_loss = last_loss = None
+            for _ in range(60):
+                if opt.local_epoch >= 10:
+                    break
+                idx = rng_local.choice(len(features), 16)
+                loss, grads = loss_and_grad(opt.params, features[idx], targets[idx])
+                first_loss = first_loss if first_loss is not None else float(loss)
+                last_loss = float(loss)
+                opt.step(grads)
+                time.sleep(0.25)
+            results[index] = (first_loss, last_loss, opt.local_epoch)
+            opt.shutdown()
+        except Exception:
+            import traceback
+
+            errors.append((index, traceback.format_exc()))
+
+    threads = [threading.Thread(target=run_peer, args=(i, d)) for i, d in enumerate(dhts)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=240)
+    try:
+        assert not errors, f"peer failures: {errors}"
+        assert len(results) == 2
+        for index, (first_loss, last_loss, epoch) in results.items():
+            assert epoch >= 2, f"peer {index} stuck at epoch {epoch}"
+            assert last_loss < first_loss / 2, (index, first_loss, last_loss)
     finally:
         for dht in dhts:
             dht.shutdown()
