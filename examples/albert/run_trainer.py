@@ -38,13 +38,13 @@ def main():
                         help="shared vocab file for text corpora: ALL peers must use the same token "
                              "mapping (first peer writes it, the rest load it)")
     parser.add_argument("--seed", type=int, default=None, help="data sampling seed (default: random per peer)")
-    parser.add_argument("--platform", default=None, help="force a jax platform (e.g. cpu, tpu)")
+    from hivemind_tpu.utils.platform import add_platform_arg, apply_platform
+
+    add_platform_arg(parser)
     args = parser.parse_args()
+    apply_platform(args)
 
     import jax
-
-    if args.platform:
-        jax.config.update("jax_platforms", args.platform)
     import jax.numpy as jnp
     import optax
 

@@ -1,0 +1,84 @@
+"""Pipelined inference over the swarm (Petals-style demo).
+
+Serve a model's transformer blocks on any mix of peers, then chain them from a
+client — each block possibly on a different machine, with DHT-based failover:
+
+    # peer 1: host blocks 0 and 2 (prints the maddr to join)
+    python examples/pipeline_inference.py --serve blk.0 blk.2
+
+    # peer 2: host block 1
+    python examples/pipeline_inference.py --serve blk.1 --initial_peers /ip4/…
+
+    # anyone: run the pipeline
+    python examples/pipeline_inference.py --num_blocks 3 --initial_peers /ip4/…
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--serve", nargs="*", default=None, help="block uids to host (server mode)")
+    parser.add_argument("--prefix", default="blk.")
+    parser.add_argument("--num_blocks", type=int, default=3)
+    parser.add_argument("--hidden_dim", type=int, default=64)
+    parser.add_argument("--seq_len", type=int, default=64)
+    parser.add_argument("--batch_size", type=int, default=4)
+    parser.add_argument("--initial_peers", nargs="*", default=[])
+    from hivemind_tpu.utils.platform import add_platform_arg, apply_platform
+
+    add_platform_arg(parser)
+    args = parser.parse_args()
+    apply_platform(args)
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from hivemind_tpu.dht import DHT
+    from hivemind_tpu.moe import RemoteSequential, Server
+    from hivemind_tpu.utils.logging import get_logger
+
+    logger = get_logger("pipeline_demo")
+
+    if args.serve:
+        dht = DHT(initial_peers=args.initial_peers, start=True)
+        for maddr in dht.get_visible_maddrs():
+            logger.info(f"to join: --initial_peers {maddr}")
+        server = Server.create(
+            expert_uids=list(args.serve), expert_cls="transformer",
+            hidden_dim=args.hidden_dim, dht=dht, start=True,
+            optim_factory=lambda: optax.sgd(1e-4),
+        )
+        logger.info(f"serving blocks {args.serve}; ctrl-c to stop")
+        try:
+            while True:
+                time.sleep(5)
+        except KeyboardInterrupt:
+            server.shutdown()
+            dht.shutdown()
+        return
+
+    assert args.initial_peers, "client mode needs --initial_peers of a serving swarm"
+    dht = DHT(initial_peers=args.initial_peers, start=True)
+    pipe = RemoteSequential(dht, args.prefix, args.num_blocks)
+    x = jnp.asarray(
+        np.random.RandomState(0).randn(args.batch_size, args.seq_len, args.hidden_dim),
+        jnp.float32,
+    )
+    start = time.perf_counter()
+    out = pipe(x)
+    out.block_until_ready()
+    elapsed = time.perf_counter() - start
+    logger.info(
+        f"pipeline of {args.num_blocks} remote blocks: {x.shape} -> {out.shape} "
+        f"in {elapsed:.2f}s (|out| = {float(jnp.linalg.norm(out)):.2f})"
+    )
+    dht.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -21,7 +21,11 @@ def main():
     parser.add_argument("--announce_host", default=None, help="externally visible host")
     parser.add_argument("--identity_path", default=None, help="persistent identity file")
     parser.add_argument("--refresh_period", type=float, default=30.0, help="health report interval")
+    from hivemind_tpu.utils.platform import add_platform_arg, apply_platform
+
+    add_platform_arg(parser)
     args = parser.parse_args()
+    apply_platform(args)
 
     dht = DHT(
         initial_peers=args.initial_peers,

@@ -26,7 +26,11 @@ def main():
     parser.add_argument("--learning_rate", type=float, default=1e-3)
     parser.add_argument("--increase_file_limit", action="store_true",
                         help="raise RLIMIT_NOFILE for many concurrent connections")
+    from hivemind_tpu.utils.platform import add_platform_arg, apply_platform
+
+    add_platform_arg(parser)
     args = parser.parse_args()
+    apply_platform(args)
 
     if args.increase_file_limit:
         from hivemind_tpu.utils.limits import increase_file_limit

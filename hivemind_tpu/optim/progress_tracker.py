@@ -178,13 +178,13 @@ class ProgressTracker:
         # our own progress may be what completes the epoch (always true for small
         # swarms): re-aggregate NOW instead of sleeping out the adaptive refresh —
         # otherwise a lone peer stalls for max_refresh_period after every report.
-        # The snapshot already counts our PREVIOUS contribution: subtract it, or
-        # every tail-of-epoch report would re-wake the fetcher (a fetch storm)
-        # only meaningful when we are AT the global epoch: a straggler's samples are
-        # not part of the global sum (and ours were not subtracted from it), so the
-        # arithmetic would either storm the fetcher or never fire
+        # The snapshot already counts our PREVIOUS contribution, so subtract it,
+        # or every tail-of-epoch report would re-wake the fetcher (a fetch storm).
         global_snapshot = self.global_progress
         if local_epoch != global_snapshot.global_epoch:
+            # a straggler's samples are not part of the global sum (and ours were
+            # not subtracted from it): the arithmetic below would either storm the
+            # fetcher or never fire, so the early wake only applies when aligned
             return
         remote_samples = max(global_snapshot.samples_accumulated - previous_local_samples, 0)
         if not global_snapshot.ready_to_update_epoch and (

@@ -1,0 +1,24 @@
+"""Shared --platform plumbing for CLI entrypoints and examples: hosts whose default
+accelerator plugin is unavailable (or wedged) can force e.g. the CPU backend. Must
+run before the first device use; ``jax.config`` is used rather than the JAX_PLATFORMS
+env var because site configuration may override the env at interpreter startup."""
+
+from __future__ import annotations
+
+import argparse
+
+
+def add_platform_arg(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "--platform",
+        default=None,
+        help="force a jax platform (e.g. cpu) — useful on hosts whose default "
+             "accelerator plugin is unavailable",
+    )
+
+
+def apply_platform(args: argparse.Namespace) -> None:
+    if getattr(args, "platform", None):
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)

@@ -152,6 +152,7 @@ def test_optimizer_with_powersgd_factory():
     results, errors = {}, []
 
     def run_peer(index, dht):
+        opt = None
         try:
             opt = Optimizer(
                 dht=dht, run_id="powersgd_opt", target_batch_size=64,
@@ -174,11 +175,13 @@ def test_optimizer_with_powersgd_factory():
                 opt.step(grads)
                 time.sleep(0.25)
             results[index] = (first_loss, last_loss, opt.local_epoch)
-            opt.shutdown()
         except Exception:
             import traceback
 
             errors.append((index, traceback.format_exc()))
+        finally:
+            if opt is not None:
+                opt.shutdown()
 
     threads = [threading.Thread(target=run_peer, args=(i, d)) for i, d in enumerate(dhts)]
     for t in threads:
