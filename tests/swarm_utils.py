@@ -1,0 +1,20 @@
+"""Shared swarm builders for the test suite (layout parity: reference
+tests/test_utils/dht_swarms.py). All tests launch REAL localhost swarms — there is
+no fake network backend, so test and production code paths are identical."""
+
+from hivemind_tpu.dht import DHT
+
+
+def launch_dht_swarm(n: int):
+    """n DHT peers on real localhost sockets; the first is everyone's bootstrap."""
+    first = DHT(start=True)
+    maddrs = [str(m) for m in first.get_visible_maddrs()]
+    return [first] + [DHT(initial_peers=maddrs, start=True) for _ in range(n - 1)]
+
+
+def shutdown_all(components, dhts):
+    """Tear down averagers/optimizers first, then their DHTs."""
+    for component in components:
+        component.shutdown()
+    for dht in dhts:
+        dht.shutdown()

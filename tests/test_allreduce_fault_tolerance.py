@@ -13,6 +13,8 @@ from hivemind_tpu.averaging.allreduce import AveragingMode
 from hivemind_tpu.dht import DHT
 from hivemind_tpu.proto import averaging_pb2
 
+from swarm_utils import launch_dht_swarm
+
 
 class Fault(Enum):
     NONE = auto()
@@ -101,9 +103,7 @@ class FaultyAverager(DecentralizedAverager):
 
 
 def launch_faulty_swarm(n_peers: int, fault_index: int, fault: Fault, part_size_bytes=64):
-    first = DHT(start=True)
-    maddrs = [str(m) for m in first.get_visible_maddrs()]
-    dhts = [first] + [DHT(initial_peers=maddrs, start=True) for _ in range(n_peers - 1)]
+    dhts = launch_dht_swarm(n_peers)
     averagers = []
     for i, dht in enumerate(dhts):
         rng = np.random.RandomState(100 + i)

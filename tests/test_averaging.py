@@ -12,11 +12,7 @@ from hivemind_tpu.averaging.control import AveragingStage
 from hivemind_tpu.dht import DHT
 from hivemind_tpu.utils.timed_storage import get_dht_time
 
-
-def launch_dht_swarm(n: int):
-    first = DHT(start=True)
-    maddrs = [str(m) for m in first.get_visible_maddrs()]
-    return [first] + [DHT(initial_peers=maddrs, start=True) for _ in range(n - 1)]
+from swarm_utils import launch_dht_swarm, shutdown_all
 
 
 def make_averagers(dhts, n_tensors=2, prefix="avgtest", **kwargs):
@@ -34,12 +30,6 @@ def make_averagers(dhts, n_tensors=2, prefix="avgtest", **kwargs):
         )
     return averagers
 
-
-def shutdown_all(averagers, dhts):
-    for averager in averagers:
-        averager.shutdown()
-    for dht in dhts:
-        dht.shutdown()
 
 
 def test_averaging_basic_group():

@@ -15,6 +15,8 @@ import jax.numpy as jnp
 from hivemind_tpu.dht import DHT
 from hivemind_tpu.optim import Optimizer
 
+from swarm_utils import launch_dht_swarm
+
 
 def _toy_problem(seed=0):
     rng = np.random.RandomState(seed)
@@ -43,9 +45,7 @@ def _make_opt(dht, **overrides):
 
 def test_join_catch_up_and_peer_death():
     features, targets, loss_and_grad = _toy_problem()
-    first = DHT(start=True)
-    maddrs = [str(m) for m in first.get_visible_maddrs()]
-    dhts = [first] + [DHT(initial_peers=maddrs, start=True) for _ in range(2)]
+    dhts = launch_dht_swarm(3)
 
     stop_all = threading.Event()
     stop_peer1 = threading.Event()

@@ -15,6 +15,8 @@ from hivemind_tpu.averaging import DecentralizedAverager, MeshAverager
 from hivemind_tpu.dht import DHT
 from hivemind_tpu.parallel import MeshTensorBridge, make_mesh
 
+from swarm_utils import launch_dht_swarm
+
 
 def test_bridge_gather_scatter_roundtrip():
     mesh = make_mesh(dp=2, tp=2, sp=2)
@@ -53,9 +55,7 @@ def test_bridge_mesh_mean_is_psum_mean():
 
 
 def _launch_swarm_pair(mesh_tree, host_tensors, prefix, **mesh_kwargs):
-    first = DHT(start=True)
-    maddrs = [str(m) for m in first.get_visible_maddrs()]
-    second = DHT(initial_peers=maddrs, start=True)
+    first, second = launch_dht_swarm(2)
     common = dict(
         prefix=prefix, start=True, target_group_size=2,
         min_matchmaking_time=1.0, request_timeout=1.0,

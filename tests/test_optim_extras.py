@@ -14,11 +14,7 @@ from hivemind_tpu.dht import DHT
 from hivemind_tpu.optim import GradScaler, PowerSGDGradientAverager, TrainingAverager
 from hivemind_tpu.utils.math_utils import get_flatten_greedy_dims, orthogonalize
 
-
-def launch_dht_swarm(n):
-    first = DHT(start=True)
-    maddrs = [str(m) for m in first.get_visible_maddrs()]
-    return [first] + [DHT(initial_peers=maddrs, start=True) for _ in range(n - 1)]
+from swarm_utils import launch_dht_swarm
 
 
 def test_math_utils():

@@ -16,11 +16,7 @@ from hivemind_tpu.dht import DHT
 from hivemind_tpu.optim import GradientAverager, Optimizer, ProgressTracker, TrainingStateAverager
 from hivemind_tpu.utils.timed_storage import get_dht_time
 
-
-def launch_dht_swarm(n: int):
-    first = DHT(start=True)
-    maddrs = [str(m) for m in first.get_visible_maddrs()]
-    return [first] + [DHT(initial_peers=maddrs, start=True) for _ in range(n - 1)]
+from swarm_utils import launch_dht_swarm
 
 
 def test_grad_averager_accumulation():

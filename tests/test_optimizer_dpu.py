@@ -17,11 +17,7 @@ import jax.numpy as jnp
 from hivemind_tpu.dht import DHT
 from hivemind_tpu.optim import GradientAverager, Optimizer, TrainingStateAverager
 
-
-def launch_dht_swarm(n: int):
-    first = DHT(start=True)
-    maddrs = [str(m) for m in first.get_visible_maddrs()]
-    return [first] + [DHT(initial_peers=maddrs, start=True) for _ in range(n - 1)]
+from swarm_utils import launch_dht_swarm
 
 
 def _toy_problem(seed=0):
