@@ -31,13 +31,20 @@ def __getattr__(name):  # lazy top-level API so `import hivemind_tpu` stays ligh
         "P2P": "hivemind_tpu.p2p",
         "PeerID": "hivemind_tpu.p2p",
         "DecentralizedAverager": "hivemind_tpu.averaging",
+        "MeshAverager": "hivemind_tpu.averaging",
+        "NATTraversal": "hivemind_tpu.p2p",
         "Optimizer": "hivemind_tpu.optim",
         "GradientAverager": "hivemind_tpu.optim",
         "TrainingStateAverager": "hivemind_tpu.optim",
+        "PowerSGDGradientAverager": "hivemind_tpu.optim",
+        "GradScaler": "hivemind_tpu.optim",
+        "TrainingAverager": "hivemind_tpu.optim",
+        "ProgressTracker": "hivemind_tpu.optim",
         "Server": "hivemind_tpu.moe",
         "ModuleBackend": "hivemind_tpu.moe",
         "RemoteExpert": "hivemind_tpu.moe",
         "RemoteMixtureOfExperts": "hivemind_tpu.moe",
+        "RemoteSequential": "hivemind_tpu.moe",
         "RemoteSwitchMixtureOfExperts": "hivemind_tpu.moe",
         "register_expert_class": "hivemind_tpu.moe",
     }
