@@ -22,6 +22,12 @@ import time
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--serve", nargs="*", default=None, help="block uids to host (server mode)")
+    parser.add_argument("--expert_cls", default="transformer",
+                        help="block class to serve; use causal_transformer for --generate")
+    parser.add_argument("--generate", type=int, default=0,
+                        help="greedy-decode this many tokens through the pipeline "
+                             "(requires causal_transformer blocks)")
+    parser.add_argument("--vocab_size", type=int, default=128)
     parser.add_argument("--prefix", default="blk.")
     parser.add_argument("--num_blocks", type=int, default=3)
     parser.add_argument("--hidden_dim", type=int, default=64)
@@ -49,7 +55,7 @@ def main():
         for maddr in dht.get_visible_maddrs():
             logger.info(f"to join: --initial_peers {maddr}")
         server = Server.create(
-            expert_uids=list(args.serve), expert_cls="transformer",
+            expert_uids=list(args.serve), expert_cls=args.expert_cls,
             hidden_dim=args.hidden_dim, dht=dht, start=True,
             optim_factory=lambda: optax.sgd(1e-4),
         )
@@ -65,6 +71,32 @@ def main():
     assert args.initial_peers, "client mode needs --initial_peers of a serving swarm"
     dht = DHT(initial_peers=args.initial_peers, start=True)
     pipe = RemoteSequential(dht, args.prefix, args.num_blocks)
+
+    if args.generate:
+        # Petals-style autoregressive decode: embedding + tied lm head live on the
+        # CLIENT; the transformer stack runs remotely as causal blocks. Causality
+        # makes right-padding exact, so every step reuses the fixed block schema
+        # (seq 64) and reads the logits at the true last position.
+        rng = np.random.RandomState(0)
+        embedding = jnp.asarray(rng.randn(args.vocab_size, args.hidden_dim) * 0.05, jnp.float32)
+        context = 64
+        tokens = [1]  # BOS
+        start = time.perf_counter()
+        for _ in range(args.generate):
+            window = tokens[-context:]
+            ids = np.zeros(context, np.int64)
+            ids[: len(window)] = window
+            hidden = embedding[jnp.asarray(ids)][None]  # [1, 64, hid]
+            hidden = pipe(hidden)
+            logits = hidden[0, len(window) - 1] @ embedding.T  # tied head
+            tokens.append(int(jnp.argmax(logits)))
+        elapsed = time.perf_counter() - start
+        logger.info(
+            f"generated {args.generate} tokens through {args.num_blocks} remote blocks "
+            f"in {elapsed:.2f}s ({args.generate / elapsed:.1f} tok/s, untrained weights): {tokens}"
+        )
+        dht.shutdown()
+        return
     x = jnp.asarray(
         np.random.RandomState(0).randn(args.batch_size, args.seq_len, args.hidden_dim),
         jnp.float32,

@@ -4,6 +4,7 @@
 sample input (batch-size-agnostic) defines the expert's I/O schema."""
 
 from hivemind_tpu.moe.server.layers.common import (
+    CausalTransformerExpert,
     FeedforwardExpert,
     NopExpert,
     TransformerExpert,

@@ -65,6 +65,34 @@ class TransformerExpert(nn.Module):
         return nn.LayerNorm(dtype=jnp.bfloat16)(x + h).astype(jnp.float32)
 
 
+class CausalTransformerExpert(nn.Module):
+    """One pre-norm DECODER block on [batch, seq, hid]: causal attention + gelu ffn.
+    The building block for pipelined autoregressive models over the swarm
+    (RemoteSequential): causality means right-padded prefixes are exact — real
+    positions never attend to the padding after them — so clients can decode with
+    a fixed schema sequence length and read the logits at the true last position."""
+
+    hidden_dim: int
+    num_heads: int = 8
+
+    @nn.compact
+    def __call__(self, x):
+        from hivemind_tpu.parallel.ring_attention import plain_attention
+
+        batch, seq, hid = x.shape
+        head_dim = hid // self.num_heads
+        dense = lambda n, name: nn.Dense(n, dtype=jnp.bfloat16, param_dtype=jnp.float32, name=name)
+        normed = nn.LayerNorm(dtype=jnp.bfloat16, name="attention_norm")(x)
+        q = dense(hid, "query")(normed).reshape(batch, seq, self.num_heads, head_dim)
+        k = dense(hid, "key")(normed).reshape(batch, seq, self.num_heads, head_dim)
+        v = dense(hid, "value")(normed).reshape(batch, seq, self.num_heads, head_dim)
+        attn = plain_attention(q, k, v, causal=True).reshape(batch, seq, hid)
+        x = x + dense(hid, "attention_out")(attn)
+        normed = nn.LayerNorm(dtype=jnp.bfloat16, name="ffn_norm")(x)
+        h = dense(4 * hid, "ffn_up")(normed)
+        return (x + dense(hid, "ffn_down")(jax.nn.gelu(h))).astype(jnp.float32)
+
+
 class NopExpert(nn.Module):
     """Identity with a dummy parameter (reference 'nop' expert for transport tests)."""
 
@@ -78,4 +106,5 @@ class NopExpert(nn.Module):
 
 register_expert_class("ffn", lambda batch, hid: np.zeros((batch, hid), np.float32))(FeedforwardExpert)
 register_expert_class("transformer", lambda batch, hid: np.zeros((batch, 64, hid), np.float32))(TransformerExpert)
+register_expert_class("causal_transformer", lambda batch, hid: np.zeros((batch, 64, hid), np.float32))(CausalTransformerExpert)
 register_expert_class("nop", lambda batch, hid: np.zeros((batch, hid), np.float32))(NopExpert)

@@ -52,11 +52,29 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str) -> 
     return acc / row_sum.transpose(0, 2, 1)[..., None]
 
 
-def plain_attention(q: jax.Array, k: jax.Array, v: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
-    """Single-device attention core with the same [B, T, H, D] convention."""
+def plain_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+) -> jax.Array:
+    """Single-device attention core with the same [B, T, H, D] convention.
+
+    :param mask: optional [B, T] key-validity mask
+    :param causal: lower-triangular masking (decoder blocks); position t attends
+        only to positions <= t, so right-padding never leaks into real positions
+    """
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    neg = jnp.finfo(scores.dtype).min
     if mask is not None:
-        scores = jnp.where(mask[:, None, None, :], scores, jnp.finfo(scores.dtype).min)
+        scores = jnp.where(mask[:, None, None, :], scores, neg)
+    if causal:
+        # offset so queries align to the END of the key sequence: incremental
+        # decode (q_len=1 vs cached k_len) sees all past keys, not just key 0
+        q_len, k_len = scores.shape[-2], scores.shape[-1]
+        tri = jnp.tril(jnp.ones((q_len, k_len), bool), k=k_len - q_len)
+        scores = jnp.where(tri[None, None], scores, neg)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

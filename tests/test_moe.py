@@ -405,3 +405,37 @@ def test_switch_grid_dropout():
     finally:
         server.shutdown()
         server.dht.shutdown()
+
+
+def test_causal_block_pipeline_decode():
+    """Causal decoder blocks over RemoteSequential: positions only depend on their
+    prefix (changing the suffix leaves earlier outputs bit-identical THROUGH the
+    RPC), which makes fixed-schema right-padded autoregressive decoding exact."""
+    from hivemind_tpu.moe import RemoteSequential
+
+    server = Server.create(
+        expert_uids=["cblk.0", "cblk.1"], expert_cls="causal_transformer", hidden_dim=16,
+        start=True, optim_factory=lambda: optax.sgd(1e-4),
+    )
+    client_dht = None
+    try:
+        import time
+        time.sleep(1.0)
+        client_dht = DHT(initial_peers=[str(m) for m in server.dht.get_visible_maddrs()], start=True)
+        pipe = RemoteSequential(client_dht, "cblk.", 2)
+
+        rng = np.random.RandomState(0)
+        prefix = rng.randn(1, 64, 16).astype(np.float32)
+        variant = prefix.copy()
+        variant[:, 10:] = rng.randn(1, 54, 16)  # different suffix from position 10
+
+        out_a = np.asarray(pipe(jnp.asarray(prefix)))
+        out_b = np.asarray(pipe(jnp.asarray(variant)))
+        # causality through two remote blocks: positions < 10 are identical
+        np.testing.assert_array_equal(out_a[:, :10], out_b[:, :10])
+        assert np.abs(out_a[:, 10:] - out_b[:, 10:]).max() > 0  # suffix does differ
+    finally:
+        if client_dht is not None:
+            client_dht.shutdown()
+        server.shutdown()
+        server.dht.shutdown()
