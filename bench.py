@@ -77,7 +77,8 @@ def _averaging_gbps(timeout: float = 420.0):
     try:
         run = subprocess.run(
             [sys.executable, script, "--num_peers", "4", "--target_group_size", "4",
-             "--num_rounds", "3", "--num_params", "4000000"],
+             "--num_rounds", "3", "--num_params", "4000000",
+             "--min_matchmaking_time", "1.0"],
             timeout=timeout, capture_output=True, text=True,
         )
         for line in run.stdout.splitlines():

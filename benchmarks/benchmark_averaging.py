@@ -24,6 +24,10 @@ def main():
     parser.add_argument("--part_size_bytes", type=int, default=2**19,
                         help="pre-compression part size (512 KiB reference default; "
                              "~2 MiB measured 3x faster on loopback, clamped to the mux cap)")
+    parser.add_argument("--min_matchmaking_time", type=float, default=2.0,
+                        help="leader's group-collection window; on loopback the group "
+                             "fills (and begins early) well before 1s, so the floor is "
+                             "pure overhead — lower it when benchmarking bandwidth")
     args = parser.parse_args()
 
     import jax
@@ -47,7 +51,7 @@ def main():
             DecentralizedAverager(
                 tensors, dht, prefix="bench", start=True,
                 target_group_size=args.target_group_size,
-                min_matchmaking_time=2.0, compression=codec,
+                min_matchmaking_time=args.min_matchmaking_time, compression=codec,
                 part_size_bytes=args.part_size_bytes,
                 initial_group_bits="" if args.num_peers <= args.target_group_size else "0",
             )

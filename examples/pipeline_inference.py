@@ -23,7 +23,12 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--serve", nargs="*", default=None, help="block uids to host (server mode)")
     parser.add_argument("--expert_cls", default="transformer",
-                        help="block class to serve; use causal_transformer for --generate")
+                        help="block class to serve; use causal_transformer or llama_block "
+                             "(RMSNorm+RoPE+GQA+SwiGLU, the Petals-style Llama shape) "
+                             "for --generate")
+    parser.add_argument("--expert_kwargs", default=None,
+                        help="JSON dict forwarded to the block class, e.g. "
+                             "'{\"num_kv_heads\": 2}' for GQA llama_block")
     parser.add_argument("--generate", type=int, default=0,
                         help="greedy-decode this many tokens through the pipeline "
                              "(requires causal_transformer blocks)")
@@ -54,9 +59,12 @@ def main():
         dht = DHT(initial_peers=args.initial_peers, start=True)
         for maddr in dht.get_visible_maddrs():
             logger.info(f"to join: --initial_peers {maddr}")
+        import json
+
         server = Server.create(
             expert_uids=list(args.serve), expert_cls=args.expert_cls,
             hidden_dim=args.hidden_dim, dht=dht, start=True,
+            expert_kwargs=json.loads(args.expert_kwargs) if args.expert_kwargs else None,
             optim_factory=lambda: optax.sgd(1e-4),
         )
         logger.info(f"serving blocks {args.serve}; ctrl-c to stop")

@@ -4,6 +4,7 @@ hivemind/hivemind_cli/run_server.py)."""
 from __future__ import annotations
 
 import argparse
+import json
 import time
 from pathlib import Path
 
@@ -20,6 +21,9 @@ def main():
     parser.add_argument("--expert_pattern", default=None, help="e.g. 'ffn.[0:16].[0:16]'")
     parser.add_argument("--expert_cls", default="ffn", help="registered expert class")
     parser.add_argument("--hidden_dim", type=int, default=1024)
+    parser.add_argument("--expert_kwargs", default=None,
+                        help="JSON dict forwarded to the expert class, e.g. "
+                             "'{\"num_kv_heads\": 2}' for GQA llama_block")
     parser.add_argument("--max_batch_size", type=int, default=4096)
     parser.add_argument("--initial_peers", nargs="*", default=[])
     parser.add_argument("--checkpoint_dir", default=None)
@@ -45,6 +49,7 @@ def main():
         expert_pattern=args.expert_pattern,
         expert_cls=args.expert_cls,
         hidden_dim=args.hidden_dim,
+        expert_kwargs=json.loads(args.expert_kwargs) if args.expert_kwargs else None,
         max_batch_size=args.max_batch_size,
         initial_peers=args.initial_peers,
         checkpoint_dir=Path(args.checkpoint_dir) if args.checkpoint_dir else None,

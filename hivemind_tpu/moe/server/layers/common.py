@@ -93,6 +93,65 @@ class CausalTransformerExpert(nn.Module):
         return (x + dense(hid, "ffn_down")(jax.nn.gelu(h))).astype(jnp.float32)
 
 
+def _rotate_half(x: jax.Array) -> jax.Array:
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(x: jax.Array, theta: float = 10000.0) -> jax.Array:
+    """Rotary position embedding over [batch, seq, heads, head_dim] (head_dim even)."""
+    seq, dim = x.shape[1], x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, dim, 2, dtype=jnp.float32) / dim)
+    angles = jnp.arange(seq, dtype=jnp.float32)[:, None] * freqs[None, :]
+    angles = jnp.concatenate([angles, angles], axis=-1)  # [seq, dim]
+    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    return x * cos + _rotate_half(x) * sin
+
+
+class LlamaBlockExpert(nn.Module):
+    """One Llama-family decoder block on [batch, seq, hid]: pre-RMSNorm, rotary
+    position embeddings, causal attention with optional grouped-query KV heads, and
+    a SwiGLU MLP. This is the block shape Petals serves for Llama models (the
+    BASELINE 'Petals-style Llama-7B block server' config): stack N of these under
+    ``RemoteSequential`` and decoding is exact with right-padded fixed schemas, same
+    as ``CausalTransformerExpert``. RoPE makes positions intrinsic to the block, so
+    the client does not ship position ids."""
+
+    hidden_dim: int
+    num_heads: int = 8
+    num_kv_heads: int = 0  # 0 = multi-head (Llama-7B); set lower for GQA (Llama-70B style)
+    rope_theta: float = 10000.0
+
+    @nn.compact
+    def __call__(self, x):
+        from hivemind_tpu.parallel.ring_attention import plain_attention
+
+        batch, seq, hid = x.shape
+        heads = self.num_heads
+        kv_heads = self.num_kv_heads or heads
+        assert heads % kv_heads == 0, (heads, kv_heads)
+        head_dim = hid // heads
+        dense = lambda n, name: nn.Dense(
+            n, use_bias=False, dtype=jnp.bfloat16, param_dtype=jnp.float32, name=name
+        )
+        normed = nn.RMSNorm(dtype=jnp.bfloat16, name="attention_norm")(x)
+        q = dense(heads * head_dim, "query")(normed).reshape(batch, seq, heads, head_dim)
+        k = dense(kv_heads * head_dim, "key")(normed).reshape(batch, seq, kv_heads, head_dim)
+        v = dense(kv_heads * head_dim, "value")(normed).reshape(batch, seq, kv_heads, head_dim)
+        q, k = apply_rope(q, self.rope_theta), apply_rope(k, self.rope_theta)
+        if kv_heads != heads:  # grouped-query: each KV head serves heads/kv_heads queries
+            k = jnp.repeat(k, heads // kv_heads, axis=2)
+            v = jnp.repeat(v, heads // kv_heads, axis=2)
+        attn = plain_attention(q, k, v, causal=True).reshape(batch, seq, hid)
+        x = x + dense(hid, "attention_out")(attn)
+        normed = nn.RMSNorm(dtype=jnp.bfloat16, name="ffn_norm")(x)
+        inner = -(-8 * hid // 3 // 8) * 8  # 8/3 * hid rounded up to a multiple of 8
+        gate = dense(inner, "ffn_gate")(normed)
+        up = dense(inner, "ffn_up")(normed)
+        return (x + dense(hid, "ffn_down")(jax.nn.silu(gate) * up)).astype(jnp.float32)
+
+
 class NopExpert(nn.Module):
     """Identity with a dummy parameter (reference 'nop' expert for transport tests)."""
 
@@ -107,4 +166,5 @@ class NopExpert(nn.Module):
 register_expert_class("ffn", lambda batch, hid: np.zeros((batch, hid), np.float32))(FeedforwardExpert)
 register_expert_class("transformer", lambda batch, hid: np.zeros((batch, 64, hid), np.float32))(TransformerExpert)
 register_expert_class("causal_transformer", lambda batch, hid: np.zeros((batch, 64, hid), np.float32))(CausalTransformerExpert)
+register_expert_class("llama_block", lambda batch, hid: np.zeros((batch, 64, hid), np.float32))(LlamaBlockExpert)
 register_expert_class("nop", lambda batch, hid: np.zeros((batch, hid), np.float32))(NopExpert)

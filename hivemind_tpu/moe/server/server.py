@@ -62,6 +62,7 @@ class Server:
         expert_pattern: Optional[str] = None,
         expert_cls: str = "ffn",
         hidden_dim: int = 1024,
+        expert_kwargs: Optional[dict] = None,
         optim_factory=None,
         max_batch_size: int = 4096,
         initial_peers: Sequence[str] = (),
@@ -72,7 +73,11 @@ class Server:
     ) -> "Server":
         """Build a server with experts from the layer registry; UIDs are either given
         or sampled from ``expert_pattern`` (e.g. 'ffn.[0:256].[0:256]') and
-        deduplicated against the DHT (reference server.py:351-411)."""
+        deduplicated against the DHT (reference server.py:351-411).
+
+        ``expert_kwargs`` are forwarded to the expert class constructor — e.g.
+        ``expert_cls='llama_block', expert_kwargs={'num_kv_heads': 2}`` serves
+        grouped-query Llama blocks."""
         import optax
 
         if dht is None:
@@ -84,7 +89,7 @@ class Server:
 
         backends = {}
         for uid in expert_uids:
-            module = name_to_block[expert_cls](hidden_dim)
+            module = name_to_block[expert_cls](hidden_dim, **(expert_kwargs or {}))
             sample = name_to_input[expert_cls](4, hidden_dim)
             # multi-tensor experts (e.g. det_dropout) declare a tuple of inputs
             sample_kwargs = (
