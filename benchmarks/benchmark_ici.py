@@ -1,0 +1,90 @@
+"""ICI tier of the two-tier backend: on-mesh reduction + host-boundary staging rate.
+
+Measures one full intra-peer averaging round of `MeshTensorBridge` — per-replica
+grads reduced with psum under shard_map (`mesh_mean`), one reduced fp32 copy staged
+to the host (`gather_to_host`), and the swarm-averaged result scattered back
+(`broadcast_scatter_from_host`) — the exact device↔host path `MeshAverager` runs per
+swarm round (averaging/ici.py). On real multi-chip hardware the reduce and the
+all-gather ride ICI; under `--platform cpu` with a virtual device mesh this records
+the host-emulation rate (a correctness/scaling harness, not an ICI bandwidth claim)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
+
+import argparse
+import json
+import time
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num_devices", type=int, default=8)
+    parser.add_argument("--num_params", type=int, default=25_000_000)
+    parser.add_argument("--num_leaves", type=int, default=8)
+    parser.add_argument("--num_rounds", type=int, default=5)
+    from hivemind_tpu.utils.platform import add_platform_arg, apply_platform
+
+    add_platform_arg(parser)
+    args = parser.parse_args()
+    if args.platform is None:
+        args.platform = "cpu"  # virtual-mesh harness by default; pass --platform tpu on a pod
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if args.platform == "cpu" and "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={args.num_devices}"
+        ).strip()
+    apply_platform(args)
+
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from hivemind_tpu.parallel import make_mesh
+    from hivemind_tpu.parallel.ici import MeshTensorBridge
+
+    n = len(jax.devices())
+    mesh = make_mesh(dp=n)
+    bridge = MeshTensorBridge(mesh)
+
+    per_leaf = args.num_params // args.num_leaves
+    rng = np.random.RandomState(0)
+    sharding = NamedSharding(mesh, P("dp"))
+    stacked = [
+        jax.device_put(rng.randn(n, per_leaf).astype(np.float32), sharding)
+        for _ in range(args.num_leaves)
+    ]
+
+    def one_round():
+        reduced = bridge.mesh_mean(stacked, axis="dp")
+        host = bridge.gather_to_host(reduced)
+        back = bridge.broadcast_scatter_from_host(stacked, host, axis="dp")
+        jax.block_until_ready(back)
+        return host
+
+    host = one_round()  # compile + numerics check
+    expected = np.mean(np.asarray(stacked[0]), axis=0)
+    np.testing.assert_allclose(host[0], expected, rtol=1e-5, atol=1e-6)
+
+    start = time.perf_counter()
+    for _ in range(args.num_rounds):
+        one_round()
+    elapsed = time.perf_counter() - start
+
+    tensor_bytes = args.num_params * 4
+    print(json.dumps({
+        "metric": "ici_tier_round_rate",
+        "value": round(tensor_bytes * args.num_rounds / elapsed / 1e9, 3),
+        "unit": "GB/s (reduced fp32 bytes through mesh_mean+gather+scatter)",
+        "extra": {
+            "devices": n, "params": args.num_params, "leaves": args.num_leaves,
+            "rounds": args.num_rounds, "seconds_per_round": round(elapsed / args.num_rounds, 4),
+            "backend": jax.default_backend(),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()
