@@ -73,7 +73,7 @@ def main():
         one_round()
     elapsed = time.perf_counter() - start
 
-    tensor_bytes = args.num_params * 4
+    tensor_bytes = per_leaf * args.num_leaves * 4  # what actually moved (// truncates)
     print(json.dumps({
         "metric": "ici_tier_round_rate",
         "value": round(tensor_bytes * args.num_rounds / elapsed / 1e9, 3),

@@ -50,7 +50,7 @@ class TransformerExpert(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        from hivemind_tpu.parallel.ring_attention import plain_attention
+        from hivemind_tpu.ops.pallas_attention import attention_auto
 
         batch, seq, hid = x.shape
         head_dim = hid // self.num_heads
@@ -58,7 +58,7 @@ class TransformerExpert(nn.Module):
         q = dense(hid, "query")(x).reshape(batch, seq, self.num_heads, head_dim)
         k = dense(hid, "key")(x).reshape(batch, seq, self.num_heads, head_dim)
         v = dense(hid, "value")(x).reshape(batch, seq, self.num_heads, head_dim)
-        attn = dense(hid, "attention_out")(plain_attention(q, k, v).reshape(batch, seq, hid))
+        attn = dense(hid, "attention_out")(attention_auto(q, k, v).reshape(batch, seq, hid))
         x = nn.LayerNorm(dtype=jnp.bfloat16)(x + attn)
         h = dense(4 * hid, "ffn_up")(x)
         h = dense(hid, "ffn_down")(jax.nn.gelu(h))
@@ -77,7 +77,7 @@ class CausalTransformerExpert(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        from hivemind_tpu.parallel.ring_attention import plain_attention
+        from hivemind_tpu.ops.pallas_attention import attention_auto
 
         batch, seq, hid = x.shape
         head_dim = hid // self.num_heads
@@ -86,7 +86,7 @@ class CausalTransformerExpert(nn.Module):
         q = dense(hid, "query")(normed).reshape(batch, seq, self.num_heads, head_dim)
         k = dense(hid, "key")(normed).reshape(batch, seq, self.num_heads, head_dim)
         v = dense(hid, "value")(normed).reshape(batch, seq, self.num_heads, head_dim)
-        attn = plain_attention(q, k, v, causal=True).reshape(batch, seq, hid)
+        attn = attention_auto(q, k, v, causal=True).reshape(batch, seq, hid)
         x = x + dense(hid, "attention_out")(attn)
         normed = nn.LayerNorm(dtype=jnp.bfloat16, name="ffn_norm")(x)
         h = dense(4 * hid, "ffn_up")(normed)
@@ -125,7 +125,7 @@ class LlamaBlockExpert(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        from hivemind_tpu.parallel.ring_attention import plain_attention
+        from hivemind_tpu.ops.pallas_attention import attention_auto
 
         batch, seq, hid = x.shape
         heads = self.num_heads
@@ -143,7 +143,7 @@ class LlamaBlockExpert(nn.Module):
         if kv_heads != heads:  # grouped-query: each KV head serves heads/kv_heads queries
             k = jnp.repeat(k, heads // kv_heads, axis=2)
             v = jnp.repeat(v, heads // kv_heads, axis=2)
-        attn = plain_attention(q, k, v, causal=True).reshape(batch, seq, hid)
+        attn = attention_auto(q, k, v, causal=True).reshape(batch, seq, hid)
         x = x + dense(hid, "attention_out")(attn)
         normed = nn.RMSNorm(dtype=jnp.bfloat16, name="ffn_norm")(x)
         inner = -(-8 * hid // 3 // 8) * 8  # 8/3 * hid rounded up to a multiple of 8

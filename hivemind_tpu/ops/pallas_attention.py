@@ -1,0 +1,166 @@
+"""Pallas TPU flash-attention kernel — the fused hot op behind the serving path.
+
+The reference has no attention kernel at all (its device math is plain torch ops;
+SURVEY §2.0); attention here is the TPU-first capability layer's hot op: MoE
+transformer/causal/llama experts and the flagship model all funnel through one
+attention core (`parallel/ring_attention.plain_attention`). This kernel fuses the
+whole softmax(QKᵀ)·V pipeline into VMEM-block passes with ONLINE softmax, so logits
+never round-trip through HBM and VMEM stays O(BLOCK_Q·BLOCK_K) regardless of
+sequence length.
+
+Layout: grid = (batch·heads, seq/BLOCK_Q, seq/BLOCK_K) — the KV loop is the LAST
+(fastest-varying) grid dimension, and the online-softmax carry (running row max,
+row sum, output accumulator) lives in VMEM scratch that persists across those grid
+steps; the carry is initialized on the first KV block and the normalized output is
+written on the last. Only one (1, BLOCK_Q, d) query tile and one (1, BLOCK_K, d)
+KV tile are resident per step. In causal mode, KV blocks entirely above the
+diagonal skip their matmuls via `pl.when` (half the FLOPs of the naive sweep);
+masking within straddling blocks matches `plain_attention` exactly.
+
+Differentiation: `flash_attention` carries a `jax.custom_vjp` whose backward
+recomputes through the reference einsum path — forward gets the fused kernel and
+O(seq) residuals, backward pays one recompute (the standard remat trade; a fused
+backward kernel is future work). On non-TPU backends the kernel runs in interpret
+mode for the test suite; `attention_auto` dispatches per backend."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK_Q = 128
+BLOCK_K = 128
+_NEG_INF = -1e30  # large-but-finite: keeps fully-masked rows NaN-free
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, out_ref, max_ref, sum_ref, acc_ref, *, seq_len: int, causal: bool
+):
+    """One (query block, KV block) grid step; carry persists in scratch refs."""
+    q_index, kv_index = pl.program_id(1), pl.program_id(2)
+    num_kv = pl.num_programs(2)
+
+    @pl.when(kv_index == 0)
+    def _init():
+        max_ref[:] = jnp.full_like(max_ref, _NEG_INF)
+        sum_ref[:] = jnp.zeros_like(sum_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_positions = q_index * BLOCK_Q + jax.lax.iota(jnp.int32, BLOCK_Q)
+    kv_start = kv_index * BLOCK_K
+    # in causal mode, blocks entirely above the diagonal contribute nothing
+    block_needed = (not causal) or (kv_start <= q_index * BLOCK_Q + BLOCK_Q - 1)
+
+    @pl.when(block_needed)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)  # [BLOCK_Q, d]
+        k = k_ref[0].astype(jnp.float32)  # [BLOCK_K, d]
+        v = v_ref[0].astype(jnp.float32)
+        scale = q.shape[-1] ** -0.5
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [BLOCK_Q, BLOCK_K]
+        kv_positions = kv_start + jax.lax.iota(jnp.int32, BLOCK_K)
+        mask = kv_positions[None, :] < seq_len  # guard the tail-padding block
+        if causal:
+            mask &= kv_positions[None, :] <= q_positions[:, None]
+        scores = jnp.where(mask, scores, _NEG_INF)
+
+        row_max = max_ref[:, 0]
+        block_max = jnp.max(scores, axis=-1)
+        new_max = jnp.maximum(row_max, block_max)
+        correction = jnp.exp(row_max - new_max)
+        probs = jnp.exp(scores - new_max[:, None])
+        acc_ref[:] = acc_ref[:] * correction[:, None] + jax.lax.dot_general(
+            probs, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        sum_ref[:, 0] = sum_ref[:, 0] * correction + jnp.sum(probs, axis=-1)
+        max_ref[:, 0] = new_max
+
+    @pl.when(kv_index == num_kv - 1)
+    def _finalize():
+        out = acc_ref[:] / jnp.maximum(sum_ref[:, 0], 1e-30)[:, None]
+        out_ref[0] = out.astype(out_ref.dtype)
+
+
+@partial(jax.jit, static_argnames=("causal", "interpret"))
+def _flash_forward(q, k, v, causal: bool = False, interpret: bool = False):
+    """q, k, v: [batch, seq, heads, head_dim] → context of the same shape."""
+    batch, seq, heads, head_dim = q.shape
+
+    def to_bh(x, block):  # [batch*heads, ceil(seq/block)*block, head_dim]
+        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(batch * heads, seq, head_dim)
+        pad = (-seq) % block
+        return jnp.pad(x, ((0, 0), (0, pad), (0, 0))) if pad else x
+
+    qb = to_bh(q, BLOCK_Q)
+    kb, vb = to_bh(k, BLOCK_K), to_bh(v, BLOCK_K)
+    out = pl.pallas_call(
+        partial(_flash_kernel, seq_len=seq, causal=causal),
+        grid=(batch * heads, qb.shape[1] // BLOCK_Q, kb.shape[1] // BLOCK_K),
+        in_specs=[
+            pl.BlockSpec((1, BLOCK_Q, head_dim), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, BLOCK_K, head_dim), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, BLOCK_K, head_dim), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, BLOCK_Q, head_dim), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((batch * heads, qb.shape[1], head_dim), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK_Q, 1), jnp.float32),  # running row max
+            pltpu.VMEM((BLOCK_Q, 1), jnp.float32),  # running row sum
+            pltpu.VMEM((BLOCK_Q, head_dim), jnp.float32),  # output accumulator
+        ],
+        interpret=interpret,
+    )(qb, kb, vb)
+    out = out[:, :seq].reshape(batch, heads, seq, head_dim)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal: bool = False, interpret: bool = False):
+    """Fused flash attention on [batch, seq, heads, head_dim] (full sequences; for
+    padded batches use the mask-capable `plain_attention`). Grad = recompute."""
+    return _flash_forward(q, k, v, causal=causal, interpret=interpret)
+
+
+def _flash_fwd(q, k, v, causal, interpret):
+    return _flash_forward(q, k, v, causal=causal, interpret=interpret), (q, k, v)
+
+
+def _flash_bwd(causal, interpret, residuals, grad_out):
+    from hivemind_tpu.parallel.ring_attention import plain_attention
+
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q, k, v: plain_attention(q, k, v, causal=causal), q, k, v)
+    return vjp(grad_out)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _flash_enabled() -> bool:
+    import os
+
+    return os.environ.get("HIVEMIND_TPU_FLASH_ATTENTION", "0") == "1"
+
+
+def attention_auto(q, k, v, mask=None, causal: bool = False):
+    """Backend dispatch for the attention core: fused Pallas kernel on TPU (full
+    sequences, opt-in via HIVEMIND_TPU_FLASH_ATTENTION=1 until chip-validated),
+    reference einsum path elsewhere or when a padding mask is given."""
+    # q_len != k_len (cached incremental decode) needs plain_attention's end-aligned
+    # causal mask; the flash kernel assumes square self-attention
+    if (
+        mask is None
+        and q.shape[1] == k.shape[1]
+        and jax.default_backend() == "tpu"
+        and _flash_enabled()
+    ):
+        return flash_attention(q, k, v, causal)
+    from hivemind_tpu.parallel.ring_attention import plain_attention
+
+    return plain_attention(q, k, v, mask=mask, causal=causal)
