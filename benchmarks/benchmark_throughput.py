@@ -22,11 +22,19 @@ def main():
     parser.add_argument("--batches_per_client", type=int, default=8)
     parser.add_argument("--batch_size", type=int, default=512)
     parser.add_argument("--backward", action="store_true", help="also run backward passes")
+    parser.add_argument("--expert_cls", default="ffn",
+                        help="registered expert class; input shape comes from its "
+                             "registry schema (block classes take [batch, seq, hid])")
+    from hivemind_tpu.utils.platform import add_platform_arg, apply_platform
+
+    add_platform_arg(parser)
     args = parser.parse_args()
 
+    if args.platform is None:
+        args.platform = "cpu"
+    apply_platform(args)
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
     jax.devices()
 
     import optax
@@ -36,9 +44,15 @@ def main():
 
     uids = [f"bench_expert.{i}" for i in range(args.num_experts)]
     server = Server.create(
-        expert_uids=uids, expert_cls="ffn", hidden_dim=args.hidden_dim,
+        expert_uids=uids, expert_cls=args.expert_cls, hidden_dim=args.hidden_dim,
         max_batch_size=8192, start=True, optim_factory=lambda: optax.sgd(1e-3),
     )
+    from hivemind_tpu.moe.server.layers import name_to_input
+
+    # the registry schema defines each class's input shape; swap in batch_size
+    sample = name_to_input[args.expert_cls](args.batch_size, args.hidden_dim)
+    assert not isinstance(sample, tuple), "multi-input expert classes are not benchmarked here"
+    sample_shape = sample.shape
     time.sleep(1.0)
     client_dht = DHT(initial_peers=[str(m) for m in server.dht.get_visible_maddrs()], start=True)
     infos = get_experts(client_dht, uids)
@@ -52,7 +66,7 @@ def main():
         rng = np.random.RandomState(index)
         try:
             for b in range(args.batches_per_client):
-                x = rng.randn(args.batch_size, args.hidden_dim).astype(np.float32)
+                x = rng.randn(*sample_shape).astype(np.float32)
                 expert = experts[(index + b) % len(experts)]
                 out = expert.forward_np(x)[0]
                 if args.backward:
@@ -76,7 +90,8 @@ def main():
         "unit": "samples/s",
         "extra": {
             "experts": args.num_experts, "clients": args.num_clients,
-            "hidden_dim": args.hidden_dim, "errors": errors[:3],
+            "hidden_dim": args.hidden_dim, "expert_cls": args.expert_cls,
+            "errors": errors[:3],
         },
     }))
     client_dht.shutdown()

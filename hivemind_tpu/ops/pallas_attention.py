@@ -50,7 +50,6 @@ def _flash_kernel(
         sum_ref[:] = jnp.zeros_like(sum_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q_positions = q_index * BLOCK_Q + jax.lax.iota(jnp.int32, BLOCK_Q)
     kv_start = kv_index * BLOCK_K
     # in causal mode, blocks entirely above the diagonal contribute nothing
     block_needed = (not causal) or (kv_start <= q_index * BLOCK_Q + BLOCK_Q - 1)
@@ -64,10 +63,14 @@ def _flash_kernel(
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [BLOCK_Q, BLOCK_K]
-        kv_positions = kv_start + jax.lax.iota(jnp.int32, BLOCK_K)
-        mask = kv_positions[None, :] < seq_len  # guard the tail-padding block
+        # rank-2 iotas: Mosaic rejects rank-1 lax.iota (pallas_guide: common pitfalls)
+        kv_positions = kv_start + jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, BLOCK_K), 1)
+        mask = kv_positions < seq_len  # guard the tail-padding block
         if causal:
-            mask &= kv_positions[None, :] <= q_positions[:, None]
+            q_positions = q_index * BLOCK_Q + jax.lax.broadcasted_iota(
+                jnp.int32, (BLOCK_Q, BLOCK_K), 0
+            )
+            mask &= kv_positions <= q_positions
         scores = jnp.where(mask, scores, _NEG_INF)
 
         row_max = max_ref[:, 0]
