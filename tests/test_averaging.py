@@ -215,3 +215,32 @@ def test_aux_peer_helps_averaging():
                 assert np.allclose(tensors[0], expected, atol=1e-4)
     finally:
         shutdown_all(nodes + [aux], dhts)
+
+
+def test_step_control_cancel_before_trigger():
+    """A scheduled-but-cancelled step must release its group slot cleanly: the
+    remaining peers still need a partner, so both cancel here and both steps report
+    failure without wedging the averagers (user-level analog of Fault.CANCEL)."""
+    dhts = launch_dht_swarm(2)
+    averagers = [
+        DecentralizedAverager(
+            [np.ones(64, np.float32) * i], dht, prefix="cancel_test", start=True,
+            target_group_size=2, min_matchmaking_time=0.5,
+        )
+        for i, dht in enumerate(dhts)
+    ]
+    try:
+        controls = [a.step(wait=False, require_trigger=True, timeout=15) for a in averagers]
+        for control in controls:
+            assert not control.triggered and not control.began_allreduce
+            assert control.cancel()
+            assert control.cancelled
+        # a subsequent un-cancelled round on the same averagers still works
+        controls = [a.step(wait=False, timeout=30) for a in averagers]
+        results = [c.result(timeout=45) for c in controls]
+        assert all(results), results
+        for averager, expected in zip(averagers, (0.5, 0.5)):
+            with averager.get_tensors() as tensors:
+                np.testing.assert_allclose(tensors[0], expected, atol=1e-6)
+    finally:
+        shutdown_all(averagers, dhts)

@@ -514,3 +514,37 @@ def test_llama_block_pipeline_decode():
             client_dht.shutdown()
         server.shutdown()
         server.dht.shutdown()
+
+
+def test_beam_search_negative_caching():
+    """Dead prefixes (grid cells with no declared experts) land in the negative
+    cache after one search (reference beam_search.py:60-74,152-160), and cached
+    searches still rank live experts correctly."""
+    server = make_server()  # declares ffn_test.{0,1}.{0,1}
+    try:
+        import time
+        time.sleep(1.0)
+        searcher = MoEBeamSearcher(server.dht, "ffn_test.", grid_size=(4, 2))
+        grid_scores = [
+            np.array([0.0, 1.0, 10.0, 10.0], np.float32),  # rows 2..3 score best but are dead
+            np.array([3.0, 0.0], np.float32),
+        ]
+        found = searcher.find_best_experts(grid_scores, beam_size=4)
+        # rows 2..3 score best but are dead: the beam never proposes them because
+        # the DHT prefix dictionary only lists coordinates that were declared
+        assert found and found[0].uid == "ffn_test.1.0"
+        assert all(info.uid.split(".")[1] in ("0", "1") for info in found)
+
+        # a prefix tree with NO experts at all gets negative-cached after one miss
+        ghost = MoEBeamSearcher(server.dht, "ghost.", grid_size=(2, 2))
+        assert ghost.find_best_experts([np.ones(2, np.float32)] * 2, beam_size=2) == []
+        assert len(ghost._negative_cache) > 0, "dead prefix was not negative-cached"
+        assert ghost.find_best_experts([np.ones(2, np.float32)] * 2, beam_size=2) == []
+
+        # the live searcher's second query (now possibly cache-assisted) must
+        # still rank live experts identically
+        again = searcher.find_best_experts(grid_scores, beam_size=4)
+        assert [i.uid for i in again] == [i.uid for i in found]
+    finally:
+        server.shutdown()
+        server.dht.shutdown()
