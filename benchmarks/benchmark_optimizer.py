@@ -21,11 +21,23 @@ def main():
     parser.add_argument("--batch_size", type=int, default=32)
     parser.add_argument("--max_epochs", type=int, default=4)
     parser.add_argument("--hidden", type=int, default=64)
-    args = parser.parse_args()
+    mode = parser.add_mutually_exclusive_group()
+    mode.add_argument("--dpu", action="store_true",
+                      help="Delayed Parameter Updates: epoch transitions run in the "
+                           "background, training continues during averaging")
+    mode.add_argument("--local_updates", action="store_true",
+                      help="async local-SGD: apply every step locally, average state "
+                           "in the background with the delta rule so concurrent "
+                           "steps survive")
+    from hivemind_tpu.utils.platform import add_platform_arg, apply_platform
 
+    add_platform_arg(parser)
+    args = parser.parse_args()
+    if args.platform is None:
+        args.platform = "cpu"
+    apply_platform(args)
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
     jax.devices()
 
     import jax.numpy as jnp
@@ -50,12 +62,21 @@ def main():
     results = {}
 
     def peer_loop(index):
+        mode_opts = {}
+        if args.dpu:
+            mode_opts["delay_optimizer_step"] = True
+        if args.local_updates:
+            # the canonical local-SGD combination (optim/optimizer.py docstring):
+            # background state averaging + delta rule to protect concurrent steps
+            mode_opts.update(
+                use_local_updates=True, delta_rule_averaging=True, delay_state_averaging=True
+            )
         opt = Optimizer(
             dht=dhts[index], run_id="bench_opt", target_batch_size=args.target_batch_size,
             params={"w": jnp.zeros(args.hidden)}, optimizer=optax.sgd(0.2),
             batch_size_per_step=args.batch_size, matchmaking_time=1.5,
             target_group_size=args.num_peers,
-            tracker_opts=dict(min_refresh_period=0.3),
+            tracker_opts=dict(min_refresh_period=0.3), **mode_opts,
         )
         local = np.random.RandomState(index)
         first_loss = last_loss = None
@@ -85,6 +106,7 @@ def main():
         "unit": "x",
         "extra": {
             "peers": args.num_peers, "seconds": round(elapsed, 1),
+            "mode": "dpu" if args.dpu else ("local_updates" if args.local_updates else "sync"),
             "per_peer": {str(k): {"first": round(v[0], 4), "last": round(v[1], 4), "epoch": v[2]} for k, v in results.items()},
         },
     }))
