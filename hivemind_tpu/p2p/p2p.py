@@ -112,16 +112,12 @@ class P2P:
         dial_timeout: float = 10.0,
     ) -> "P2P":
         self = object.__new__(cls)
+        self._identity_lock_fd: Optional[int] = None
         if identity is None:
-            if identity_path is not None and os.path.exists(identity_path):
-                with open(identity_path, "rb") as f:
-                    identity = Ed25519PrivateKey.from_bytes(f.read())
+            if identity_path is not None:
+                identity, self._identity_lock_fd = cls._load_or_create_identity(identity_path)
             else:
                 identity = Ed25519PrivateKey()
-                if identity_path is not None:
-                    fd = os.open(identity_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
-                    with os.fdopen(fd, "wb") as f:
-                        f.write(identity.to_bytes())
         self.identity = identity
         self.peer_id = PeerID.from_private_key(identity)
         self._handlers: Dict[str, _Handler] = {}
@@ -135,7 +131,14 @@ class P2P:
         self._listen_host = listen_host
         self._announce_host = announce_host or listen_host
 
-        self._server = await asyncio.start_server(self._on_inbound_connection, listen_host, listen_port)
+        try:
+            self._server = await asyncio.start_server(
+                self._on_inbound_connection, listen_host, listen_port
+            )
+        except BaseException:
+            if self._identity_lock_fd is not None:
+                os.close(self._identity_lock_fd)  # don't leave the identity "taken"
+            raise
         self._listen_port = self._server.sockets[0].getsockname()[1]
         logger.debug(f"P2P {self.peer_id} listening on {listen_host}:{self._listen_port}")
 
@@ -156,6 +159,50 @@ class P2P:
         fd = os.open(identity_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
         with os.fdopen(fd, "wb") as f:
             f.write(key.to_bytes())
+
+    class IdentityTakenError(RuntimeError):
+        """Another live process already uses this identity file."""
+
+    @staticmethod
+    def _load_or_create_identity(identity_path: str):
+        """Open-or-create the identity file, flock it for this P2P's lifetime, then
+        read (or first-write) the key through the SAME descriptor.
+
+        Capability parity with the reference's ``is_identity_taken`` probe
+        (p2p_daemon.py): two peers sharing one identity make the swarm misroute to
+        whichever connected last. The reference detects the collision by dialing the
+        swarm; single-host collisions (the common operator mistake — two servers
+        started with the same --identity_path) are caught earlier and determin-
+        istically by an OS file lock, released automatically if the process dies.
+        Locking BEFORE writing means two simultaneous first-time creates cannot
+        truncate each other's key; a pre-provisioned read-only key file (e.g. a
+        mounted secret) is opened read-only — flock works on those descriptors too.
+
+        :returns: (identity, locked fd)"""
+        import fcntl
+
+        try:
+            fd = os.open(identity_path, os.O_RDWR | os.O_CREAT, 0o600)
+        except PermissionError:
+            fd = os.open(identity_path, os.O_RDONLY)  # read-only provisioned key
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            raise P2P.IdentityTakenError(
+                f"identity file {identity_path!r} is locked by another live process; "
+                f"two peers must not share one identity"
+            )
+        try:
+            existing = os.pread(fd, 4096, 0)
+            if existing:
+                return Ed25519PrivateKey.from_bytes(existing), fd
+            identity = Ed25519PrivateKey()
+            os.pwrite(fd, identity.to_bytes(), 0)
+            return identity, fd
+        except BaseException:
+            os.close(fd)
+            raise
 
     async def replicate(self) -> "P2P":
         """The reference attaches extra clients to one daemon (p2p_daemon.py:replicate);
@@ -445,6 +492,9 @@ class P2P:
             await self._server.wait_closed()
         except Exception:
             pass
+        if self._identity_lock_fd is not None:
+            os.close(self._identity_lock_fd)  # releases the identity flock
+            self._identity_lock_fd = None
 
     def __repr__(self):
         return f"P2P({self.peer_id}, port={self._listen_port}, handlers={len(self._handlers)})"

@@ -343,3 +343,43 @@ async def test_many_concurrent_streams_one_connection():
     finally:
         await client.shutdown()
         await server.shutdown()
+
+
+@pytest.mark.asyncio
+async def test_identity_file_collision_detected(tmp_path):
+    """Two live P2P instances must not share one identity file (capability parity:
+    reference is_identity_taken, p2p_daemon.py): the second create() fails fast,
+    and the identity becomes reusable once the holder shuts down."""
+    path = str(tmp_path / "id.key")
+    first = await P2P.create(identity_path=path)
+    try:
+        with pytest.raises(P2P.IdentityTakenError):
+            await P2P.create(identity_path=path)
+    finally:
+        await first.shutdown()
+    second = await P2P.create(identity_path=path)  # lock released on shutdown
+    assert second.peer_id == first.peer_id  # same key file -> same identity
+    await second.shutdown()
+
+
+@pytest.mark.asyncio
+async def test_identity_file_readonly_and_failed_create(tmp_path):
+    """A pre-provisioned read-only key file works (flock on a read-only fd), and a
+    create() that fails AFTER taking the lock releases it for the next attempt."""
+    import os
+
+    path = str(tmp_path / "ro.key")
+    P2P.generate_identity(path)
+    os.chmod(path, 0o400)
+    node = await P2P.create(identity_path=path)
+    await node.shutdown()
+
+    # occupy a port, then fail a create() bound to it: the lock must be released
+    blocker = await P2P.create()
+    busy_port = blocker.listen_port
+    with pytest.raises(OSError):
+        await P2P.create(identity_path=path, listen_port=busy_port)
+    retry = await P2P.create(identity_path=path)  # identity is NOT stuck "taken"
+    assert retry.peer_id == node.peer_id
+    await retry.shutdown()
+    await blocker.shutdown()
