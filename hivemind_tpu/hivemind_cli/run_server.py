@@ -24,6 +24,10 @@ def main():
     parser.add_argument("--expert_kwargs", default=None,
                         help="JSON dict forwarded to the expert class, e.g. "
                              "'{\"num_kv_heads\": 2}' for GQA llama_block")
+    parser.add_argument("--custom_module_path", default=None,
+                        help="path to a .py file whose @register_expert_class "
+                             "decorators run before the server starts (capability "
+                             "parity: reference custom_experts.py add_custom_models)")
     parser.add_argument("--max_batch_size", type=int, default=4096)
     parser.add_argument("--initial_peers", nargs="*", default=[])
     parser.add_argument("--checkpoint_dir", default=None)
@@ -40,6 +44,18 @@ def main():
         from hivemind_tpu.utils.limits import increase_file_limit
 
         increase_file_limit()
+
+    if args.custom_module_path:
+        import importlib.util
+        import sys
+
+        spec = importlib.util.spec_from_file_location("hivemind_custom_experts", args.custom_module_path)
+        if spec is None or spec.loader is None:
+            raise RuntimeError(f"cannot load {args.custom_module_path}")
+        module = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = module  # classes' __module__ must resolve (pickling etc.)
+        spec.loader.exec_module(module)  # runs the @register_expert_class decorators
+        logger.info(f"loaded custom expert module {args.custom_module_path}")
 
     import optax
 

@@ -131,23 +131,33 @@ class P2P:
         self._listen_host = listen_host
         self._announce_host = announce_host or listen_host
 
+        self._server = None
         try:
             self._server = await asyncio.start_server(
                 self._on_inbound_connection, listen_host, listen_port
             )
-        except BaseException:
-            if self._identity_lock_fd is not None:
-                os.close(self._identity_lock_fd)  # don't leave the identity "taken"
-            raise
-        self._listen_port = self._server.sockets[0].getsockname()[1]
-        logger.debug(f"P2P {self.peer_id} listening on {listen_host}:{self._listen_port}")
+            self._listen_port = self._server.sockets[0].getsockname()[1]
+            logger.debug(f"P2P {self.peer_id} listening on {listen_host}:{self._listen_port}")
 
-        for maddr in initial_peers:
-            maddr = Multiaddr.parse(maddr) if isinstance(maddr, str) else maddr
-            try:
-                await self.connect(maddr)
-            except Exception as e:
-                logger.warning(f"could not reach initial peer {maddr}: {e}")
+            for maddr in initial_peers:
+                maddr = Multiaddr.parse(maddr) if isinstance(maddr, str) else maddr
+                try:
+                    await self.connect(maddr)
+                except Exception as e:
+                    logger.warning(f"could not reach initial peer {maddr}: {e}")
+        except BaseException:
+            # any failure mid-create must not leak the listener, peer connections
+            # already established, or the identity flock ("taken") for the process
+            if self._server is not None:
+                self._server.close()
+            for conn in list(self._all_connections):
+                try:
+                    await asyncio.shield(conn.close())
+                except BaseException:
+                    pass  # best-effort: cancellation must not strand later closes
+            if self._identity_lock_fd is not None:
+                os.close(self._identity_lock_fd)
+            raise
         return self
 
     # ------------------------------------------------------------------ identity
@@ -187,12 +197,15 @@ class P2P:
             fd = os.open(identity_path, os.O_RDONLY)  # read-only provisioned key
         try:
             fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
-        except OSError:
+        except BlockingIOError:
             os.close(fd)
             raise P2P.IdentityTakenError(
                 f"identity file {identity_path!r} is locked by another live process; "
                 f"two peers must not share one identity"
             )
+        except OSError:
+            os.close(fd)  # e.g. ENOLCK on lockless network mounts: NOT a duplicate peer
+            raise
         try:
             existing = os.pread(fd, 4096, 0)
             if existing:

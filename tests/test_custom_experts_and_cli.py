@@ -13,6 +13,37 @@ import optax
 import pytest
 
 
+def read_child_until(proc, marker: str, timeout: float = 60.0) -> str:
+    """Accumulate a child's stdout until ``marker`` appears, EOF, or the deadline.
+
+    Reads the RAW non-blocking fd in chunks: selecting on the fd and then calling
+    ``readline()`` silently strands any second line inside the TextIO buffer (the
+    fd shows no data, the selector never fires again) — a hang this helper exists
+    to avoid. The child must be started with stdout=PIPE, stderr=STDOUT."""
+    import os
+    import selectors
+
+    import codecs
+
+    fd = proc.stdout.fileno()
+    os.set_blocking(fd, False)
+    decoder = codecs.getincrementaldecoder("utf-8")("replace")
+    deadline = time.monotonic() + timeout
+    seen = ""
+    with selectors.DefaultSelector() as sel:
+        sel.register(fd, selectors.EVENT_READ)
+        while time.monotonic() < deadline and marker not in seen:
+            if not sel.select(timeout=1.0):
+                if proc.poll() is not None:
+                    break
+                continue
+            chunk = os.read(fd, 65536)
+            if not chunk:
+                break  # EOF
+            seen += decoder.decode(chunk)
+    return seen
+
+
 def test_register_custom_expert_end_to_end():
     from hivemind_tpu.dht import DHT
     from hivemind_tpu.moe import RemoteExpert, Server, get_experts, register_expert_class
@@ -69,27 +100,43 @@ def test_cli_starts_and_listens(module, extra):
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
     )
     try:
-        import selectors
+        buffer = read_child_until(proc, "listening", timeout=60)
+        assert "listening" in buffer, (
+            f"{module} never announced a listening address; output: {buffer[-500:]}"
+        )
+    finally:
+        proc.kill()
+        proc.wait()
 
-        # select-based read loop: a silent-but-alive child must FAIL at the deadline,
-        # not block the whole suite inside readline()
-        sel = selectors.DefaultSelector()
-        sel.register(proc.stdout, selectors.EVENT_READ)
-        deadline = time.monotonic() + 60
-        saw_listening = False
-        buffer = ""
-        while time.monotonic() < deadline and not saw_listening:
-            if not sel.select(timeout=1.0):
-                if proc.poll() is not None:
-                    break
-                continue
-            chunk = proc.stdout.readline()
-            if not chunk:
-                break
-            buffer += chunk
-            if "listening" in chunk:
-                saw_listening = True
-        assert saw_listening, f"{module} never announced a listening address; output: {buffer[-500:]}"
+
+def test_run_server_custom_module_path(tmp_path):
+    """--custom_module_path imports a user file whose @register_expert_class
+    decorators run before the server builds experts (reference custom_experts.py)."""
+    custom = tmp_path / "my_experts.py"
+    custom.write_text(
+        "import flax.linen as nn\n"
+        "import numpy as np\n"
+        "from hivemind_tpu.moe import register_expert_class\n\n"
+        "@register_expert_class('scaled_cli', lambda b, h: np.zeros((b, h), np.float32))\n"
+        "class Scaled(nn.Module):\n"
+        "    hidden_dim: int\n"
+        "    @nn.compact\n"
+        "    def __call__(self, x):\n"
+        "        return x * self.param('s', nn.initializers.ones, ())\n"
+    )
+    import os
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": "."}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "hivemind_tpu.hivemind_cli.run_server",
+         "--expert_uids", "scaled_cli_grid.0", "--expert_cls", "scaled_cli",
+         "--hidden_dim", "8", "--custom_module_path", str(custom), "--platform", "cpu"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+    try:
+        seen = read_child_until(proc, "serving 1 experts", timeout=60)
+        assert "serving 1 experts" in seen, f"server did not start: {seen[-2000:]}"
+        assert "loaded custom expert module" in seen
     finally:
         proc.kill()
         proc.wait()
