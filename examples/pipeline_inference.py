@@ -29,6 +29,9 @@ def main():
     parser.add_argument("--expert_kwargs", default=None,
                         help="JSON dict forwarded to the block class, e.g. "
                              "'{\"num_kv_heads\": 2}' for GQA llama_block")
+    parser.add_argument("--no_sessions", action="store_true",
+                        help="decode via right-padded full recompute instead of "
+                             "KV-cache sessions")
     parser.add_argument("--generate", type=int, default=0,
                         help="greedy-decode this many tokens through the pipeline "
                              "(requires causal_transformer blocks)")
@@ -82,26 +85,58 @@ def main():
 
     if args.generate:
         # Petals-style autoregressive decode: embedding + tied lm head live on the
-        # CLIENT; the transformer stack runs remotely as causal blocks. Causality
-        # makes right-padding exact, so every step reuses the fixed block schema
-        # (seq 64) and reads the logits at the true last position.
+        # CLIENT; the transformer stack runs remotely as causal blocks. KV-cache
+        # decode sessions on each serving peer make every step O(context): one
+        # prefill with the prompt, then one single-token RPC chain per token
+        # (--no_sessions falls back to the right-padded full recompute, which
+        # causality makes exact at the fixed schema length).
+        import uuid
+
         rng = np.random.RandomState(0)
         embedding = jnp.asarray(rng.randn(args.vocab_size, args.hidden_dim) * 0.05, jnp.float32)
-        context = 64
         tokens = [1]  # BOS
         start = time.perf_counter()
-        for _ in range(args.generate):
-            window = tokens[-context:]
-            ids = np.zeros(context, np.int64)
-            ids[: len(window)] = window
-            hidden = embedding[jnp.asarray(ids)][None]  # [1, 64, hid]
-            hidden = pipe(hidden)
-            logits = hidden[0, len(window) - 1] @ embedding.T  # tied head
-            tokens.append(int(jnp.argmax(logits)))
+        if args.no_sessions:
+            context = 64
+            for _ in range(args.generate):
+                window = tokens[-context:]
+                ids = np.zeros(context, np.int64)
+                ids[: len(window)] = window
+                hidden = embedding[jnp.asarray(ids)][None]  # [1, 64, hid]
+                hidden = pipe(hidden)
+                logits = hidden[0, len(window) - 1] @ embedding.T  # tied head
+                tokens.append(int(jnp.argmax(logits)))
+        else:
+            session = uuid.uuid4().hex
+            hidden = np.asarray(embedding[jnp.asarray(tokens)])[None]  # prompt [1, 1, hid]
+            out = pipe.decode_step(hidden, session, reset=True)
+            # re-prefill window when a session hits its capacity: half of the
+            # advertised per-session cache so a restarted session has headroom
+            capacity = pipe.decode_capacity() or 128
+            window = max(1, min(64, capacity // 2))
+            for remaining in range(args.generate, 0, -1):
+                logits = jnp.asarray(out[0, -1]) @ embedding.T
+                tokens.append(int(jnp.argmax(logits)))
+                if remaining == 1:
+                    break  # the last token needs no further step
+                try:
+                    step = np.asarray(embedding[jnp.asarray(tokens[-1:])])[None]
+                    out = pipe.decode_step(step, session)
+                except Exception:
+                    # session capacity (server --decode_max_len) reached: restart a
+                    # fresh session prefilled with the recent token window — the
+                    # same sliding-context approximation --no_sessions uses
+                    pipe.close_decode_session(session)
+                    session = uuid.uuid4().hex
+                    recent = tokens[-window:]
+                    hidden = np.asarray(embedding[jnp.asarray(recent)])[None]
+                    out = pipe.decode_step(hidden, session, reset=True)
         elapsed = time.perf_counter() - start
+        mode = "right-padded recompute" if args.no_sessions else "KV-cache sessions"
         logger.info(
             f"generated {args.generate} tokens through {args.num_blocks} remote blocks "
-            f"in {elapsed:.2f}s ({args.generate / elapsed:.1f} tok/s, untrained weights): {tokens}"
+            f"in {elapsed:.2f}s ({args.generate / elapsed:.1f} tok/s, {mode}, "
+            f"untrained weights): {tokens}"
         )
         dht.shutdown()
         return

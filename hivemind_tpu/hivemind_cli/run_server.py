@@ -24,6 +24,9 @@ def main():
     parser.add_argument("--expert_kwargs", default=None,
                         help="JSON dict forwarded to the expert class, e.g. "
                              "'{\"num_kv_heads\": 2}' for GQA llama_block")
+    parser.add_argument("--decode_max_len", type=int, default=256,
+                        help="KV-cache decode session capacity (prompt + generated "
+                             "tokens) per client session")
     parser.add_argument("--custom_module_path", default=None,
                         help="path to a .py file whose @register_expert_class "
                              "decorators run before the server starts (capability "
@@ -69,6 +72,7 @@ def main():
         max_batch_size=args.max_batch_size,
         initial_peers=args.initial_peers,
         checkpoint_dir=Path(args.checkpoint_dir) if args.checkpoint_dir else None,
+        decode_max_len=args.decode_max_len,
         optim_factory=lambda: optax.adam(args.learning_rate),
         start=True,
     )

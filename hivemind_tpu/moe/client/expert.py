@@ -74,24 +74,29 @@ class RemoteExpert:
 
     # ------------------------------------------------------------------ raw RPC
 
-    async def _call(self, method: str, tensors: Sequence[np.ndarray]) -> List[np.ndarray]:
+    async def _call(
+        self, method: str, tensors: Sequence[np.ndarray], metadata: bytes = b""
+    ) -> List[np.ndarray]:
         serialized = [serialize_tensor(np.asarray(t, np.float32)) for t in tensors]
         payload = sum(len(s.buffer) for s in serialized)
         if payload <= MAX_UNARY_PAYLOAD_SIZE:
             response = await self.p2p.call_protobuf_handler(
                 self.peer_id,
                 f"ConnectionHandler.rpc_{method}",
-                runtime_pb2.ExpertRequest(uid=self.uid, tensors=serialized),
+                runtime_pb2.ExpertRequest(uid=self.uid, tensors=serialized, metadata=metadata),
                 runtime_pb2.ExpertResponse,
             )
             return [deserialize_tensor(t) for t in response.tensors]
-        # streaming path for big payloads
+        # streaming path for big payloads (metadata rides the first message)
 
         async def requests():
             first = True
             for tensor in serialized:
                 for chunk in split_tensor_for_streaming(tensor, 2**20):
-                    yield runtime_pb2.ExpertRequest(uid=self.uid if first else "", tensors=[chunk])
+                    yield runtime_pb2.ExpertRequest(
+                        uid=self.uid if first else "", tensors=[chunk],
+                        metadata=metadata if first else b"",
+                    )
                     first = False
 
         from hivemind_tpu.compression import deserialize_tensor_stream
@@ -108,6 +113,17 @@ class RemoteExpert:
 
     def forward_np(self, *xs: np.ndarray) -> List[np.ndarray]:
         return RemoteExpertWorker.run_coroutine(self._call("forward", list(xs)))
+
+    def decode_np(self, x: np.ndarray, session_id: str, reset: bool = False) -> np.ndarray:
+        """One KV-cache decode-session step on the serving peer (rpc_decode):
+        the prefill call (``reset=True``) seeds the session with the prompt chunk,
+        later calls advance one token each — O(context) per token instead of the
+        right-padded O(context²) recompute. Sessions are sticky to the peer; a
+        continuation on an evicted session raises (restart with ``reset=True``).
+        Prefill chunks over the unary cap use the streaming decode RPC."""
+        metadata = MSGPackSerializer.dumps({"session_id": session_id, "reset": reset})
+        [output] = RemoteExpertWorker.run_coroutine(self._call("decode", [x], metadata))
+        return output
 
     def backward_np(self, *tensors: np.ndarray) -> List[np.ndarray]:
         """``tensors`` = forward inputs followed by one grad per output."""

@@ -93,6 +93,8 @@ class RemoteSequential:
         self.p2p = get_loop_runner().run_coroutine(dht.replicate_p2p())
         self._blocks: Dict[int, _ResilientBlock] = {}
         self._resolved_at: Dict[int, float] = {}
+        self._decode_routes: Dict[str, list] = {}  # session_id -> pinned block handles
+        self.max_decode_routes = 256  # oldest pinned routes drop beyond this
         self._lock = threading.Lock()
 
     def __len__(self) -> int:
@@ -134,6 +136,58 @@ class RemoteSequential:
         for index in range(start, stop):
             x = self._call_block(index, x)
         return x
+
+    def decode_step(self, x, session_id: str, reset: bool = False):
+        """Chain one KV-cache decode-session step through every block: the prefill
+        call (``reset=True``) seeds each block's session with the prompt chunk
+        [batch, prompt_len, hid], later calls advance a single token
+        [batch, 1, hid] — O(context) per token vs the O(context²) right-padded
+        ``__call__`` decode. Sessions are STICKY to the peers resolved at prefill:
+        the route is pinned for the session's lifetime (the periodic DHT
+        re-resolution must not silently move a session to a cache-less peer), and
+        a dead peer raises instead of failing over (restart generation with
+        ``reset=True`` to re-prefill on a replacement)."""
+        import numpy as np
+
+        x = np.asarray(x, np.float32)
+        if reset:
+            # pin the route with FRESH immutable handles: _ResilientBlock objects
+            # are shared and re-pointed in place by the periodic re-resolution, so
+            # pinning them would let the route silently move to a cache-less peer
+            pinned = [
+                RemoteExpert(self._resolve_info(index), self.p2p)
+                for index in range(self.num_blocks)
+            ]
+            with self._lock:
+                self._decode_routes[session_id] = pinned
+                while len(self._decode_routes) > self.max_decode_routes:
+                    self._decode_routes.pop(next(iter(self._decode_routes)))  # oldest
+        else:
+            with self._lock:
+                pinned = self._decode_routes.get(session_id)
+            if pinned is None:
+                raise RuntimeError(
+                    f"decode session {session_id!r} has no pinned route here; "
+                    f"start it with reset=True"
+                )
+        for block in pinned:
+            # plain RemoteExpert: no retry/re-resolution — a replacement peer
+            # would not hold this session's cache
+            x = block.decode_np(x, session_id, reset=reset)
+        return x
+
+    def close_decode_session(self, session_id: str) -> None:
+        """Forget a pinned decode route (the server side expires by TTL/LRU)."""
+        with self._lock:
+            self._decode_routes.pop(session_id, None)
+
+    def decode_capacity(self) -> Optional[int]:
+        """The tightest ``decode_max_len`` across the pipeline's current servers
+        (each advertises it via rpc_info), or None if a block lacks sessions."""
+        capacities = [
+            self._block(index).info.get("decode_max_len") for index in range(self.num_blocks)
+        ]
+        return None if any(c is None for c in capacities) else min(capacities)
 
     def __getitem__(self, index: int):
         """A callable handle to one block (e.g. for partial pipelines)."""

@@ -28,10 +28,13 @@ _STREAM_CHUNK = 2**20  # 1 MiB chunks inside stream replies
 
 
 class ConnectionHandler(ServicerBase):
-    def __init__(self, backends: Dict[str, ModuleBackend]):
+    def __init__(self, backends: Dict[str, ModuleBackend], decode_max_len: int = 256):
+        from hivemind_tpu.moe.server.decode_session import DecodeSessionManager
+
         self.backends = backends
         self.forward_pools: Dict[str, TaskPool] = {}
         self.backward_pools: Dict[str, TaskPool] = {}
+        self.decode_sessions = DecodeSessionManager(backends, max_len=decode_max_len)
         for name, backend in backends.items():
             self.forward_pools[name] = TaskPool(
                 backend.forward, f"{name}_forward", max_batch_size=backend.max_batch_size
@@ -49,7 +52,10 @@ class ConnectionHandler(ServicerBase):
         backend = self.backends.get(request.uid)
         if backend is None:
             raise KeyError(f"unknown expert {request.uid!r}")
-        return runtime_pb2.ExpertInfoResponse(serialized_info=MSGPackSerializer.dumps(backend.get_info()))
+        info = backend.get_info()
+        if self.decode_sessions.supports(request.uid):
+            info["decode_max_len"] = self.decode_sessions.max_len
+        return runtime_pb2.ExpertInfoResponse(serialized_info=MSGPackSerializer.dumps(info))
 
     async def _run_forward(self, uid: str, tensors: List[np.ndarray]) -> List[np.ndarray]:
         pool = self.forward_pools.get(uid)
@@ -82,6 +88,36 @@ class ConnectionHandler(ServicerBase):
         grads = await self._run_backward(request.uid, inputs)
         return runtime_pb2.ExpertResponse(tensors=[serialize_tensor(g) for g in grads])
 
+    async def _run_decode(self, uid: str, metadata: bytes, tensors: List[np.ndarray]) -> np.ndarray:
+        import asyncio
+
+        meta = MSGPackSerializer.loads(metadata) if metadata else {}
+        session_id = meta.get("session_id")
+        if not session_id:
+            raise ValueError("rpc_decode requires a session_id in request metadata")
+        [x] = tensors
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self.decode_sessions.decode, uid, str(session_id), x,
+            bool(meta.get("reset", False)),
+        )
+
+    async def rpc_decode(self, request: runtime_pb2.ExpertRequest, context: P2PContext) -> runtime_pb2.ExpertResponse:
+        """One KV-cache session step (decode_session.py). Metadata carries
+        ``{"session_id": str, "reset": bool}``; sessions bypass the batching
+        pools — each holds its own per-client device cache."""
+        tensors = [deserialize_tensor(t) for t in request.tensors]
+        output = await self._run_decode(request.uid, request.metadata, tensors)
+        return runtime_pb2.ExpertResponse(tensors=[serialize_tensor(output)])
+
+    async def rpc_decode_stream(
+        self, requests: AsyncIterator[runtime_pb2.ExpertRequest], context: P2PContext
+    ) -> AsyncIterator[runtime_pb2.ExpertResponse]:
+        """Streaming variant for prefill chunks over the unary payload cap."""
+        uid, metadata, tensors = await self._collect_stream_with_metadata(requests)
+        output = await self._run_decode(uid, metadata, tensors)
+        for message in self._stream_response([output]):
+            yield message
+
     async def rpc_forward_stream(
         self, requests: AsyncIterator[runtime_pb2.ExpertRequest], context: P2PContext
     ) -> AsyncIterator[runtime_pb2.ExpertResponse]:
@@ -112,6 +148,25 @@ class ConnectionHandler(ServicerBase):
         tensors = await deserialize_tensor_stream(parts())
         assert uid is not None, "stream carried no expert uid"
         return uid, tensors
+
+    @staticmethod
+    async def _collect_stream_with_metadata(requests: AsyncIterator[runtime_pb2.ExpertRequest]):
+        """Like _collect_stream, additionally capturing the FIRST message's metadata."""
+        uid = None
+        metadata = b""
+
+        async def parts():
+            nonlocal uid, metadata
+            async for request in requests:
+                if uid is None and request.uid:
+                    uid = request.uid
+                if not metadata and request.metadata:
+                    metadata = request.metadata
+                yield list(request.tensors)
+
+        tensors = await deserialize_tensor_stream(parts())
+        assert uid is not None, "stream carried no expert uid"
+        return uid, metadata, tensors
 
     @staticmethod
     def _stream_response(outputs: List[np.ndarray]):

@@ -1,0 +1,154 @@
+"""Server-side KV-cache decode sessions for pipelined autoregressive inference.
+
+Petals serves Llama blocks with per-client attention caches so each generated token
+costs O(context) instead of the O(context²) right-padded recompute that
+`RemoteSequential.__call__` implies. This is the session layer for the same
+capability on the TPU stack: a client opens a session per block uid (a msgpack
+`{"session_id", "reset"}` rides `ExpertRequest.metadata` — no proto change), the
+first call prefills the prompt into fresh caches, and every later call advances one
+token. Caches live on-device in the block's compact kv-heads layout
+(`init_decode_cache` on the block class), the step function is jitted once per
+(uid, batch, chunk-length) signature, and sessions expire by TTL / LRU cap so an
+abandoned client cannot pin device memory.
+
+No reference equivalent (the reference serves stateless experts; Petals is its
+downstream project — README.md:35-40). Fault note: decode sessions are sticky to
+the serving peer — if it dies, the client must re-prefill on a replacement
+(`RemoteSequential.decode_step` raises rather than silently resuming with an empty
+cache)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hivemind_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _next_pow2(n: int) -> int:
+    power = 1
+    while power < n:
+        power *= 2
+    return power
+
+
+class _Session:
+    __slots__ = ("cache_k", "cache_v", "index", "last_used", "lock")
+
+    def __init__(self, cache_k, cache_v):
+        self.cache_k, self.cache_v = cache_k, cache_v
+        self.index = 0
+        self.last_used = time.monotonic()
+        self.lock = threading.Lock()
+
+
+class DecodeSessionManager:
+    """Per-(uid, session_id) KV caches + jitted decode steps for one server.
+
+    :param max_len: cache capacity per session (prompt + generated tokens)
+    :param session_ttl: seconds of inactivity before a session is evicted
+    :param max_sessions: LRU cap across all uids
+    """
+
+    def __init__(self, backends, max_len: int = 256, session_ttl: float = 600.0,
+                 max_sessions: int = 64):
+        self.backends = backends
+        self.max_len, self.session_ttl, self.max_sessions = max_len, session_ttl, max_sessions
+        self._sessions: Dict[Tuple[str, str], _Session] = {}
+        self._step_fns: Dict[Tuple[str, int, int], callable] = {}
+        self._lock = threading.Lock()
+
+    def supports(self, uid: str) -> bool:
+        backend = self.backends.get(uid)
+        return backend is not None and hasattr(backend.module, "init_decode_cache")
+
+    def _evict_locked(self) -> None:
+        now = time.monotonic()
+        expired = [k for k, s in self._sessions.items() if now - s.last_used > self.session_ttl]
+        for key in expired:
+            del self._sessions[key]
+        while len(self._sessions) > self.max_sessions:
+            oldest = min(self._sessions, key=lambda k: self._sessions[k].last_used)
+            del self._sessions[oldest]
+
+    def _step_fn(self, uid: str, batch: int, new_len: int):
+        key = (uid, batch, new_len)
+        fn = self._step_fns.get(key)
+        if fn is None:
+            module = self.backends[uid].module
+
+            def step(params, x, cache_k, cache_v, index):
+                return module.apply({"params": params}, x, cache_k, cache_v, index)
+
+            fn = self._step_fns[key] = jax.jit(step, donate_argnums=(2, 3))
+        return fn
+
+    def decode(self, uid: str, session_id: str, x: np.ndarray, reset: bool) -> np.ndarray:
+        """One session step: prefill (``reset=True``, chunk = the prompt) or advance
+        one token in an existing session. Returns the block output for the chunk.
+        Raises ``KeyError`` for a continuation on an unknown/evicted session."""
+        backend = self.backends.get(uid)
+        if backend is None or not self.supports(uid):
+            raise KeyError(f"expert {uid!r} does not support decode sessions")
+        x = np.asarray(x, np.float32)
+        assert x.ndim == 3, f"decode input must be [batch, chunk, hid], got {x.shape}"
+        batch, new_len = x.shape[0], x.shape[1]
+        if new_len > self.max_len:
+            raise ValueError(f"chunk of {new_len} exceeds session max_len={self.max_len}")
+
+        key = (uid, session_id)
+        with self._lock:
+            self._evict_locked()
+            session = self._sessions.get(key)
+            if reset:
+                cache_k, cache_v = backend.module.init_decode_cache(batch, self.max_len)
+                session = self._sessions[key] = _Session(cache_k, cache_v)
+            elif session is None:
+                # NEVER silently prefill a continuation: an evicted/expired/unknown
+                # session would return semantically-garbage activations. The client
+                # must restart generation with reset=True.
+                raise KeyError(
+                    f"unknown or expired decode session {session_id!r} for {uid!r}; "
+                    f"restart generation with reset=True"
+                )
+            session.last_used = time.monotonic()
+
+        with session.lock:
+            if session.index == 0:
+                pass  # prefill: any chunk length (causal within the chunk)
+            elif new_len != 1:
+                raise ValueError(
+                    f"session {session_id!r} already holds {session.index} positions; "
+                    f"only 1-token steps may follow the prefill (got chunk {new_len})"
+                )
+            if session.index + new_len > self.max_len:
+                raise ValueError(
+                    f"session {session_id!r} is full ({session.index}/{self.max_len})"
+                )
+            if session.cache_k.shape[0] != batch:
+                raise ValueError(
+                    f"session {session_id!r} batch is {session.cache_k.shape[0]}, got {batch}"
+                )
+            # bucket prefill lengths to powers of two so the jit cache stays at
+            # O(log max_len) entries per (uid, batch) instead of one compile per
+            # distinct prompt length. Padded tail slots of the cache are invisible
+            # (the continuation mask stops at `index`) and are overwritten in place
+            # by subsequent single-token steps; padded prefill OUTPUTS are sliced
+            # off, and causal attention keeps real prefill positions exact.
+            padded_len = new_len if new_len == 1 else min(_next_pow2(new_len), self.max_len)
+            if padded_len != new_len:
+                x = np.pad(x, ((0, 0), (0, padded_len - new_len), (0, 0)))
+            step = self._step_fn(uid, batch, padded_len)
+            y, session.cache_k, session.cache_v = step(
+                backend.snapshot_params(), jnp.asarray(x), session.cache_k,
+                session.cache_v, jnp.int32(session.index),
+            )
+            session.index += new_len
+            return np.asarray(y)[:, :new_len]

@@ -104,14 +104,18 @@ class ModuleBackend:
             batch = np.pad(batch, pad_width)
         return jnp.asarray(batch), n
 
+    def snapshot_params(self):
+        """The current parameter pytree under the state lock (for read-only use by
+        auxiliary executors, e.g. decode sessions)."""
+        with self._state_lock:
+            return self.params
+
     def forward(self, *inputs: np.ndarray) -> List[np.ndarray]:
         """Inference on a concatenated batch (no parameter updates)."""
         assert len(inputs) == self.num_inputs, (len(inputs), self.num_inputs)
         padded = [self._pad(np.asarray(x, np.float32)) for x in inputs]
         n = padded[0][1]
-        with self._state_lock:
-            params = self.params
-        outs = self._jit_forward(params, *(p for p, _ in padded))
+        outs = self._jit_forward(self.snapshot_params(), *(p for p, _ in padded))
         return [np.asarray(out)[:n] for out in outs]
 
     def backward(self, *tensors: np.ndarray) -> List[np.ndarray]:

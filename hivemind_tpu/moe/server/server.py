@@ -40,11 +40,12 @@ class Server:
         *,
         update_period: float = 30.0,
         checkpoint_dir: Optional[Path] = None,
+        decode_max_len: int = 256,
         loop_runner: Optional[LoopRunner] = None,
     ):
         self.dht, self.backends = dht, backends
         self.update_period = update_period
-        self.handler = ConnectionHandler(backends)
+        self.handler = ConnectionHandler(backends, decode_max_len=decode_max_len)
         self.runtime = Runtime(self.handler.all_pools())
         self.checkpoint_saver = (
             CheckpointSaver(backends, checkpoint_dir) if checkpoint_dir is not None else None
@@ -68,6 +69,7 @@ class Server:
         initial_peers: Sequence[str] = (),
         dht: Optional[DHT] = None,
         checkpoint_dir: Optional[Path] = None,
+        decode_max_len: int = 256,
         start: bool = False,
         **backend_kwargs,
     ) -> "Server":
@@ -103,7 +105,7 @@ class Server:
             loaded = load_experts(backends, checkpoint_dir)
             if loaded:
                 logger.info(f"restored {loaded} experts from {checkpoint_dir}")
-        server = cls(dht, backends, checkpoint_dir=checkpoint_dir)
+        server = cls(dht, backends, checkpoint_dir=checkpoint_dir, decode_max_len=decode_max_len)
         if start:
             server.run_in_background(await_ready=True)
         return server

@@ -548,3 +548,112 @@ def test_beam_search_negative_caching():
     finally:
         server.shutdown()
         server.dht.shutdown()
+
+
+def test_decode_cache_matches_full_forward():
+    """KV-cache decode (prefill + per-token steps) is bit-identical to the full
+    causal forward for both decoder block families (GQA caches stay compact)."""
+    from hivemind_tpu.moe.server.layers.common import CausalTransformerExpert, LlamaBlockExpert
+
+    rng = np.random.RandomState(0)
+    for cls, kwargs in (
+        (CausalTransformerExpert, dict(num_heads=4)),
+        (LlamaBlockExpert, dict(num_heads=4, num_kv_heads=2)),
+    ):
+        block = cls(hidden_dim=16, **kwargs)
+        x = jnp.asarray(rng.randn(2, 12, 16).astype(np.float32))
+        params = block.init(jax.random.PRNGKey(0), x)
+        full = np.asarray(block.apply(params, x))
+
+        cache_k, cache_v = block.init_decode_cache(batch=2, max_len=32)
+        y, cache_k, cache_v = block.apply(params, x[:, :5], cache_k, cache_v, 0)
+        outs = [np.asarray(y)]
+        for t in range(5, 12):
+            y, cache_k, cache_v = block.apply(params, x[:, t:t + 1], cache_k, cache_v, t)
+            outs.append(np.asarray(y))
+        np.testing.assert_array_equal(np.concatenate(outs, axis=1), full)
+
+
+def test_decode_sessions_over_rpc():
+    """Petals-style incremental decoding through the swarm: per-session KV caches
+    on the serving peer, driven by RemoteSequential.decode_step — outputs match
+    the right-padded full-recompute pipeline exactly, per generated position."""
+    import uuid
+    from hivemind_tpu.moe import RemoteSequential
+
+    server = Server.create(
+        expert_uids=["dblk.0", "dblk.1"], expert_cls="llama_block", hidden_dim=16,
+        start=True, optim_factory=lambda: optax.sgd(1e-4),
+    )
+    client_dht = None
+    try:
+        import time
+        time.sleep(1.0)
+        client_dht = DHT(initial_peers=[str(m) for m in server.dht.get_visible_maddrs()], start=True)
+        pipe = RemoteSequential(client_dht, "dblk.", 2)
+
+        rng = np.random.RandomState(3)
+        hidden = rng.randn(1, 9, 16).astype(np.float32)  # prompt 6 + 3 decode steps
+        session = uuid.uuid4().hex
+
+        # session path: prefill the 6-token prompt, then three 1-token steps
+        out_prefill = pipe.decode_step(hidden[:, :6], session, reset=True)
+        step_outs = [pipe.decode_step(hidden[:, t:t + 1], session) for t in range(6, 9)]
+
+        # reference path: right-padded full recompute at schema length 64
+        padded = np.zeros((1, 64, 16), np.float32)
+        padded[:, :9] = hidden
+        full = np.asarray(pipe(jnp.asarray(padded)))
+
+        np.testing.assert_allclose(out_prefill, full[:, :6], rtol=1e-5, atol=1e-5)
+        for offset, out in enumerate(step_outs):
+            np.testing.assert_allclose(out, full[:, 6 + offset:7 + offset], rtol=1e-5, atol=1e-5)
+
+        # a fresh session with the same id on ANOTHER input must reset cleanly
+        out_reset = pipe.decode_step(hidden[:, :6], session, reset=True)
+        np.testing.assert_allclose(out_reset, out_prefill, rtol=1e-6, atol=1e-6)
+
+        # a continuation on an UNKNOWN session must raise, never silently prefill
+        with pytest.raises(RuntimeError, match="no pinned route"):
+            pipe.decode_step(hidden[:, :1], "never-prefilled")
+        from hivemind_tpu.p2p.p2p import P2PHandlerError
+
+        block0 = pipe._block(0)
+        with pytest.raises(P2PHandlerError, match="unknown or expired"):
+            block0.decode_np(hidden[:, :1], "server-side-unknown", reset=False)
+    finally:
+        if client_dht is not None:
+            client_dht.shutdown()
+        server.shutdown()
+        server.dht.shutdown()
+
+
+def test_decode_prefill_streams_over_unary_cap():
+    """A prefill chunk above the 2 MiB unary split streams through
+    rpc_decode_stream and still matches the session's incremental math."""
+    import uuid
+    from hivemind_tpu.moe import RemoteSequential
+
+    server = Server.create(
+        expert_uids=["big.0"], expert_cls="causal_transformer", hidden_dim=512,
+        decode_max_len=1200, start=True, optim_factory=lambda: optax.sgd(1e-4),
+    )
+    client_dht = None
+    try:
+        import time
+        time.sleep(1.0)
+        client_dht = DHT(initial_peers=[str(m) for m in server.dht.get_visible_maddrs()], start=True)
+        pipe = RemoteSequential(client_dht, "big.", 1)
+        rng = np.random.RandomState(0)
+        prompt = rng.randn(1, 1100, 512).astype(np.float32)  # 2.25 MB > unary cap
+        session = uuid.uuid4().hex
+        out = pipe.decode_step(prompt, session, reset=True)
+        assert out.shape == (1, 1100, 512) and np.isfinite(out).all()
+        # one incremental token afterwards proves the streamed prefill seeded the cache
+        nxt = pipe.decode_step(prompt[:, :1], session)
+        assert nxt.shape == (1, 1, 512) and np.isfinite(nxt).all()
+    finally:
+        if client_dht is not None:
+            client_dht.shutdown()
+        server.shutdown()
+        server.dht.shutdown()
