@@ -7,7 +7,23 @@
 // handshake THROUGH it, so the relay only ever sees AEAD ciphertext.
 //
 // Control protocol (length-prefixed frames: u32 big-endian length + payload):
-//   REGISTER  'R' <peer_id bytes>        -> 'O'   (this conn becomes the control line)
+//   REGISTER  'R' <peer_id bytes>        -> 'C' <32B challenge>  (when libcrypto is
+//             available; peer must prove it owns the Ed25519 key its peer_id hashes)
+//             -> 'O' directly in legacy mode (no libcrypto on the host)
+//   PROOF     'P' <32B ed25519 pubkey> <64B signature>  -> 'O'  (signature over
+//             "hivemind-relay-register:" + challenge + peer_id; sha256(pubkey) must
+//             equal the multihash digest in peer_id). A VALID proof also evicts any
+//             stale control line for the same peer_id — only the key owner can, so
+//             a NAT-rebound peer reclaims its identity immediately instead of
+//             waiting for TCP keepalive to reap the dead line.
+//             Known limitation: the proof does not authenticate the RELAY, so a
+//             malicious relay the victim actively registers through can proxy the
+//             live challenge from another relay and capture the victim's
+//             registration THERE (availability only: dialers still authenticate the
+//             target end-to-end via Noise, so a captured INCOMING cannot be
+//             answered convincingly — the dial just fails). Closing it requires a
+//             relay keypair + encrypted control line (Noise to a pinned relay id);
+//             message-binding schemes don't survive a transparent-proxy relay.
 //   DIAL      'D' <16B token> <target_id>-> 'O' then splice  (sent on a FRESH conn)
 //   ACCEPT    'A' <16B token>            -> 'O' then splice  (fresh conn from target)
 //   INCOMING  'I' <16B token>            relay -> target's control line
@@ -19,6 +35,7 @@
 // Build: g++ -O2 -std=c++17 -o relay_daemon relay_daemon.cpp   (see Makefile)
 
 #include <arpa/inet.h>
+#include <dlfcn.h>
 #include <errno.h>
 #include <fcntl.h>
 #include <netinet/in.h>
@@ -28,6 +45,7 @@
 #include <stdlib.h>
 #include <string.h>
 #include <sys/epoll.h>
+#include <sys/random.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -53,6 +71,79 @@ static double now_ms() {
   return duration_cast<duration<double, std::milli>>(steady_clock::now().time_since_epoch()).count();
 }
 
+// ---- Ed25519 registration proof via the system libcrypto ----------------------
+// This image ships libcrypto.so.3 but no OpenSSL headers, so the few stable-ABI
+// entry points needed for one-shot Ed25519 verification are declared here and
+// resolved with dlopen at startup. If libcrypto is absent the daemon degrades to
+// the legacy unauthenticated first-registration-wins behavior (and says so).
+namespace relay_crypto {
+typedef struct evp_pkey_st EVP_PKEY;
+typedef struct evp_md_ctx_st EVP_MD_CTX;
+static constexpr int EVP_PKEY_ED25519 = 1087;  // NID_ED25519, stable since 1.1.1
+
+static EVP_PKEY* (*new_raw_public_key)(int, void*, const unsigned char*, size_t) = nullptr;
+static void (*pkey_free)(EVP_PKEY*) = nullptr;
+static EVP_MD_CTX* (*md_ctx_new)() = nullptr;
+static void (*md_ctx_free)(EVP_MD_CTX*) = nullptr;
+static int (*digest_verify_init)(EVP_MD_CTX*, void**, const void*, void*, EVP_PKEY*) = nullptr;
+static int (*digest_verify)(EVP_MD_CTX*, const unsigned char*, size_t, const unsigned char*, size_t) = nullptr;
+static unsigned char* (*sha256_fn)(const unsigned char*, size_t, unsigned char*) = nullptr;
+
+static bool load() {
+  void* lib = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_LOCAL);
+  if (!lib) lib = dlopen("libcrypto.so", RTLD_NOW | RTLD_LOCAL);
+  if (!lib) return false;
+  new_raw_public_key = (decltype(new_raw_public_key))dlsym(lib, "EVP_PKEY_new_raw_public_key");
+  pkey_free = (decltype(pkey_free))dlsym(lib, "EVP_PKEY_free");
+  md_ctx_new = (decltype(md_ctx_new))dlsym(lib, "EVP_MD_CTX_new");
+  md_ctx_free = (decltype(md_ctx_free))dlsym(lib, "EVP_MD_CTX_free");
+  digest_verify_init = (decltype(digest_verify_init))dlsym(lib, "EVP_DigestVerifyInit");
+  digest_verify = (decltype(digest_verify))dlsym(lib, "EVP_DigestVerify");
+  sha256_fn = (decltype(sha256_fn))dlsym(lib, "SHA256");
+  return new_raw_public_key && pkey_free && md_ctx_new && md_ctx_free &&
+         digest_verify_init && digest_verify && sha256_fn;
+}
+
+static bool available = false;
+
+static bool sha256(const std::string& data, unsigned char out[32]) {
+  if (!available) return false;
+  return sha256_fn((const unsigned char*)data.data(), data.size(), out) != nullptr;
+}
+
+static bool ed25519_verify(const std::string& pubkey_raw, const std::string& message,
+                           const std::string& signature) {
+  if (!available || pubkey_raw.size() != 32 || signature.size() != 64) return false;
+  EVP_PKEY* key = new_raw_public_key(EVP_PKEY_ED25519, nullptr,
+                                     (const unsigned char*)pubkey_raw.data(), pubkey_raw.size());
+  if (!key) return false;
+  EVP_MD_CTX* ctx = md_ctx_new();
+  bool ok = false;
+  if (ctx && digest_verify_init(ctx, nullptr, nullptr, nullptr, key) == 1) {
+    ok = digest_verify(ctx, (const unsigned char*)signature.data(), signature.size(),
+                       (const unsigned char*)message.data(), message.size()) == 1;
+  }
+  if (ctx) md_ctx_free(ctx);
+  pkey_free(key);
+  return ok;
+}
+}  // namespace relay_crypto
+
+static bool fill_random(unsigned char* buf, size_t len) {
+  // getrandom(2): no fd, so an attacker holding connections open (EMFILE) cannot
+  // starve challenge generation the way an open("/dev/urandom") path could
+  size_t have = 0;
+  while (have < len) {
+    ssize_t n = getrandom(buf + have, len - have, 0);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    have += (size_t)n;
+  }
+  return true;
+}
+
 enum class ConnState { ReadingFrame, Control, SplicedWaiting, Spliced, Closed };
 
 struct Conn {
@@ -61,6 +152,8 @@ struct Conn {
   std::string inbuf;        // frame assembly
   std::string outbuf;       // pending writes
   std::string peer_id;      // set for control lines
+  std::string pending_peer_id;  // REGISTER received, awaiting Ed25519 proof
+  std::string challenge;        // 32B nonce the proof must sign
   std::string token;        // set for pending dial/accept conns
   int peer_fd = -1;         // spliced counterpart
   double created_ms = 0;
@@ -162,24 +255,78 @@ static void splice_pair(Conn* a, Conn* b) {
   if (!b->inbuf.empty()) { queue_write(a, b->inbuf.data(), b->inbuf.size()); b->inbuf.clear(); }
 }
 
+static void refuse_and_close(Conn* c) {
+  // 'E' then close — but through the flush path, or the refusal frame would be
+  // discarded with the fd (close_conn does not drain outbuf)
+  queue_frame(c, "E");
+  c->closing_after_flush = true;
+  c->read_paused = true;
+  c->created_ms = now_ms();
+  update_events(c);
+}
+
 static void handle_control_frame(Conn* c, const std::string& payload) {
   if (payload.empty()) { close_conn(c->fd); return; }
   char kind = payload[0];
   if (kind == 'R') {
     std::string peer_id = payload.substr(1);
     if (peer_id.empty()) { close_conn(c->fd); return; }
-    // First registration wins: a later REGISTER for the same peer_id is REFUSED
-    // while the original control line is alive, so an attacker cannot evict a
-    // registered peer and capture its INCOMING notifications. (Proof-of-identity
-    // via Ed25519 challenge would be stronger, but this image has no crypto
-    // library for the daemon; dead lines are reaped by TCP keepalive + EPOLLHUP,
-    // after which the legitimate peer can re-register.)
+    if (relay_crypto::available) {
+      // Challenge-response registration: a peer_id is sha2-256 multihash
+      // (0x12 0x20 + 32B digest of the Ed25519 pubkey), so ownership is provable.
+      if (peer_id.size() != 34 || peer_id[0] != 0x12 || (unsigned char)peer_id[1] != 0x20) {
+        refuse_and_close(c);
+        return;
+      }
+      unsigned char nonce[32];
+      if (!fill_random(nonce, sizeof(nonce))) { refuse_and_close(c); return; }
+      c->pending_peer_id = peer_id;
+      c->challenge.assign((char*)nonce, sizeof(nonce));
+      queue_frame(c, std::string("C") + c->challenge);
+      return;
+    }
+    // Legacy (no libcrypto): first registration wins — a later REGISTER for the
+    // same peer_id is REFUSED while the original control line is alive, so an
+    // attacker cannot evict a registered peer and capture its INCOMING
+    // notifications; dead lines are reaped by TCP keepalive + EPOLLHUP.
     auto old = g_control.find(peer_id);
     if (old != g_control.end() && old->second != c->fd) {
       queue_frame(c, "E");
       return;
     }
+    // re-registering a different id on the same line must not leave a dangling
+    // g_control entry pointing at this fd (a later DIAL would deref a stale conn)
+    if (!c->peer_id.empty() && c->peer_id != peer_id) g_control.erase(c->peer_id);
     c->peer_id = peer_id;
+    g_control[c->peer_id] = c->fd;
+    c->state = ConnState::Control;
+    enable_keepalive(c->fd);
+    queue_frame(c, "O");
+  } else if (kind == 'P') {
+    // PROOF: 'P' + 32B raw Ed25519 pubkey + 64B signature over
+    // "hivemind-relay-register:" + challenge + peer_id
+    if (c->pending_peer_id.empty() || payload.size() != 1 + 32 + 64) {
+      refuse_and_close(c);
+      return;
+    }
+    std::string pubkey = payload.substr(1, 32);
+    std::string signature = payload.substr(33, 64);
+    unsigned char digest[32];
+    bool id_matches = relay_crypto::sha256(pubkey, digest) &&
+                      memcmp(digest, c->pending_peer_id.data() + 2, 32) == 0;
+    std::string message = "hivemind-relay-register:" + c->challenge + c->pending_peer_id;
+    if (!id_matches || !relay_crypto::ed25519_verify(pubkey, message, signature)) {
+      refuse_and_close(c);
+      return;
+    }
+    // proven owner: evict any stale control line for this id (only the key holder
+    // reaches this point, so this is reclamation, not hijack)
+    auto old = g_control.find(c->pending_peer_id);
+    if (old != g_control.end() && old->second != c->fd) close_conn(old->second);
+    if (!c->peer_id.empty() && c->peer_id != c->pending_peer_id) g_control.erase(c->peer_id);
+    c->peer_id = c->pending_peer_id;
+    c->pending_peer_id.clear();
+    c->challenge.clear();
     g_control[c->peer_id] = c->fd;
     c->state = ConnState::Control;
     enable_keepalive(c->fd);
@@ -188,12 +335,13 @@ static void handle_control_frame(Conn* c, const std::string& payload) {
     std::string token = payload.substr(1, 16);
     std::string target = payload.substr(17);
     auto reg = g_control.find(target);
-    if (reg == g_control.end()) { queue_frame(c, "E"); close_conn(c->fd); return; }
+    auto target_conn = reg == g_control.end() ? g_conns.end() : g_conns.find(reg->second);
+    if (target_conn == g_conns.end()) { refuse_and_close(c); return; }
     c->token = token;
     c->state = ConnState::SplicedWaiting;
     g_pending_dials[token] = c->fd;
     c->created_ms = now_ms();
-    queue_frame(g_conns[reg->second], std::string("I") + token);
+    queue_frame(target_conn->second, std::string("I") + token);
   } else if (kind == 'W') {
     sockaddr_in observed{};
     socklen_t olen = sizeof(observed);
@@ -209,8 +357,9 @@ static void handle_control_frame(Conn* c, const std::string& payload) {
   } else if (kind == 'A' && payload.size() >= 17) {
     std::string token = payload.substr(1, 16);
     auto pend = g_pending_dials.find(token);
-    if (pend == g_pending_dials.end()) { queue_frame(c, "E"); close_conn(c->fd); return; }
-    Conn* dialer = g_conns[pend->second];
+    auto dialer_it = pend == g_pending_dials.end() ? g_conns.end() : g_conns.find(pend->second);
+    if (dialer_it == g_conns.end()) { refuse_and_close(c); return; }
+    Conn* dialer = dialer_it->second;
     g_pending_dials.erase(pend);
     dialer->token.clear();
     splice_pair(dialer, c);
@@ -248,6 +397,7 @@ static void on_readable(Conn* c) {
         c->inbuf.erase(0, 4 + len);
         handle_control_frame(c, payload);
         if (g_conns.find(c->fd) == g_conns.end()) return;  // frame handler closed us
+        if (c->closing_after_flush) return;  // refused: flush 'E', ignore further input
       }
     }
   }
@@ -281,6 +431,9 @@ static void on_writable(Conn* c) {
 int main(int argc, char** argv) {
   int port = argc > 1 ? atoi(argv[1]) : 34000;
   signal(SIGPIPE, SIG_IGN);
+  relay_crypto::available = relay_crypto::load();
+  if (!relay_crypto::available)
+    fprintf(stderr, "relay: libcrypto unavailable, registrations are UNAUTHENTICATED\n");
 
   int listener = socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;

@@ -32,6 +32,28 @@ async def _recv_frame(reader: asyncio.StreamReader) -> bytes:
     return await reader.readexactly(length)
 
 
+async def register_control(
+    reader: asyncio.StreamReader, writer: asyncio.StreamWriter, peer_id_bytes: bytes, identity
+) -> bytes:
+    """Run the relay REGISTER exchange, answering an Ed25519 challenge if the daemon
+    issues one ('C' + 32B nonce → 'P' + raw pubkey + raw signature over
+    ``"hivemind-relay-register:" + challenge + peer_id``). Returns the final frame
+    ('O' on success). A valid proof also reclaims the peer_id from a stale control
+    line — only the key owner can evict a registration."""
+    import base64
+
+    await _send_frame(writer, b"R" + peer_id_bytes)
+    response = await _recv_frame(reader)
+    if response[:1] == b"C":
+        challenge = response[1:]
+        message = b"hivemind-relay-register:" + challenge + peer_id_bytes
+        signature = base64.b64decode(identity.sign(message))  # sign() returns base64
+        pubkey = identity.get_public_key().to_bytes()
+        await _send_frame(writer, b"P" + pubkey + signature)
+        response = await _recv_frame(reader)
+    return response
+
+
 class RelayClient:
     """Attach a P2P node to a relay daemon.
 
@@ -53,8 +75,9 @@ class RelayClient:
 
     async def _register(self) -> None:
         reader, writer = await asyncio.open_connection(self.host, self.port)
-        await _send_frame(writer, b"R" + self.p2p.peer_id.to_bytes())
-        response = await _recv_frame(reader)
+        response = await register_control(
+            reader, writer, self.p2p.peer_id.to_bytes(), self.p2p.identity
+        )
         if response != b"O":
             raise ConnectionError(f"relay refused registration: {response!r}")
         self._control_writer = writer

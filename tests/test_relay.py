@@ -80,43 +80,116 @@ async def _raw_conn(port):
     return await asyncio.open_connection("127.0.0.1", port)
 
 
-async def test_relay_register_hijack_refused(relay_process):
-    """First registration wins: a second REGISTER for a live peer_id is refused,
-    and the id becomes available again once the original line closes."""
-    from hivemind_tpu.p2p.relay import _recv_frame, _send_frame
+async def test_relay_register_requires_key_proof(relay_process):
+    """Registration is authenticated: the daemon challenges every REGISTER and only
+    an Ed25519 signature from the key the peer_id hashes is accepted. An attacker
+    without the key cannot register the victim's id; the owner CAN re-register and
+    evicts its own stale control line (NAT-rebind reclamation)."""
+    import base64
+
+    from hivemind_tpu.p2p.peer_id import PeerID
+    from hivemind_tpu.p2p.relay import _recv_frame, _send_frame, register_control
+    from hivemind_tpu.utils.crypto import Ed25519PrivateKey
 
     port = relay_process
-    peer_id = b"victim-peer-id"
-    r1, w1 = await _raw_conn(port)
-    await _send_frame(w1, b"R" + peer_id)
-    assert await _recv_frame(r1) == b"O"
+    victim = Ed25519PrivateKey()
+    victim_id = PeerID.from_private_key(victim).to_bytes()
 
+    # capability probe: a daemon without system libcrypto degrades to legacy
+    # unauthenticated registration ('O' straight away) — nothing to test there
+    probe_r, probe_w = await _raw_conn(port)
+    await _send_frame(probe_w, b"R" + victim_id)
+    probe_response = await _recv_frame(probe_r)
+    probe_w.close()
+    if probe_response[:1] != b"C":
+        pytest.skip("relay daemon running without libcrypto: legacy unauthenticated mode")
+
+    r1, w1 = await _raw_conn(port)
+    assert await register_control(r1, w1, victim_id, victim) == b"O"
+
+    # attacker presents the victim's (public) pubkey — hash matches — but can only
+    # sign with its own key: the signature check must fail
+    attacker = Ed25519PrivateKey()
     r2, w2 = await _raw_conn(port)
-    await _send_frame(w2, b"R" + peer_id)
-    assert await _recv_frame(r2) == b"E"  # hijack attempt refused
+    await _send_frame(w2, b"R" + victim_id)
+    challenge_frame = await _recv_frame(r2)
+    assert challenge_frame[:1] == b"C" and len(challenge_frame) == 33
+    message = b"hivemind-relay-register:" + challenge_frame[1:] + victim_id
+    forged = base64.b64decode(attacker.sign(message))
+    await _send_frame(w2, b"P" + victim.get_public_key().to_bytes() + forged)
+    assert await _recv_frame(r2) == b"E"
     w2.close()
 
-    w1.close()
-    await asyncio.sleep(0.2)  # let the daemon reap the closed control line
+    # a pubkey whose hash doesn't match the claimed peer_id is also refused,
+    # even with a valid signature from that key
     r3, w3 = await _raw_conn(port)
-    await _send_frame(w3, b"R" + peer_id)
-    assert await _recv_frame(r3) == b"O"
+    await _send_frame(w3, b"R" + victim_id)
+    challenge_frame = await _recv_frame(r3)
+    message = b"hivemind-relay-register:" + challenge_frame[1:] + victim_id
+    await _send_frame(
+        w3, b"P" + attacker.get_public_key().to_bytes() + base64.b64decode(attacker.sign(message))
+    )
+    assert await _recv_frame(r3) == b"E"
     w3.close()
+
+    # the owner reclaims: second registration with a valid proof evicts line 1
+    r4, w4 = await _raw_conn(port)
+    assert await register_control(r4, w4, victim_id, victim) == b"O"
+    assert await r1.read(100) == b""  # old control line was closed by the daemon
+    w4.close()
+    w1.close()
+
+
+async def test_relay_reregister_different_id_no_stale_route(relay_process):
+    """One control line re-registering under a NEW peer_id must drop the route to its
+    old id: a later DIAL for the old id gets a clean refusal (regression: the stale
+    g_control entry used to deref a dangling conn and crash the daemon)."""
+    from hivemind_tpu.p2p.peer_id import PeerID
+    from hivemind_tpu.p2p.relay import _recv_frame, _send_frame, register_control
+    from hivemind_tpu.utils.crypto import Ed25519PrivateKey
+
+    port = relay_process
+    key_a, key_b = Ed25519PrivateKey(), Ed25519PrivateKey()
+    id_a = PeerID.from_private_key(key_a).to_bytes()
+    id_b = PeerID.from_private_key(key_b).to_bytes()
+
+    r1, w1 = await _raw_conn(port)
+    assert await register_control(r1, w1, id_a, key_a) == b"O"
+    assert await register_control(r1, w1, id_b, key_b) == b"O"  # same line, new id
+
+    rd, wd = await _raw_conn(port)
+    await _send_frame(wd, b"D" + os.urandom(16) + id_a)
+    try:
+        refusal = await _recv_frame(rd)
+    except asyncio.IncompleteReadError:
+        refusal = b"E"  # abrupt close is also a refusal, not a crash
+    assert refusal == b"E"
+    wd.close()
+
+    # the daemon is still alive and routes to the NEW id
+    rd2, wd2 = await _raw_conn(port)
+    await _send_frame(wd2, b"D" + os.urandom(16) + id_b)
+    incoming = await _recv_frame(r1)
+    assert incoming[:1] == b"I"
+    for w in (w1, wd2):
+        w.close()
 
 
 async def test_relay_backpressure_bounds_memory(relay_process):
     """Fast sender + slow receiver: the daemon must PAUSE reading (epoll interest
     drop) instead of buffering at line rate; memory stays bounded and every byte
     still arrives once the receiver drains (ADVICE r1: level-triggered EPOLLIN)."""
-    from hivemind_tpu.p2p.relay import _recv_frame, _send_frame
+    from hivemind_tpu.p2p.peer_id import PeerID
+    from hivemind_tpu.p2p.relay import _recv_frame, _send_frame, register_control
+    from hivemind_tpu.utils.crypto import Ed25519PrivateKey
 
     port = relay_process
     total = 32 * 1024 * 1024
-    peer_id = b"bp-server"
+    server_key = Ed25519PrivateKey()
+    peer_id = PeerID.from_private_key(server_key).to_bytes()
 
     rs, ws = await _raw_conn(port)
-    await _send_frame(ws, b"R" + peer_id)
-    assert await _recv_frame(rs) == b"O"
+    assert await register_control(rs, ws, peer_id, server_key) == b"O"
 
     rd, wd = await _raw_conn(port)
     token = os.urandom(16)
