@@ -108,14 +108,22 @@ def main() -> None:
 
     config = AlbertConfig.base(max_position=seq_len)
     optimizer = optax.adamw(1e-4)
-    model, train_step = make_train_step(config, optimizer, masked_loss_fraction=masked_fraction)
+
+    _steps = {}  # remat -> (model, train_step); built lazily, jit-cached across probes
+
+    def get_step(remat: bool):
+        if remat not in _steps:
+            cfg = AlbertConfig.base(max_position=seq_len, remat=remat)
+            _steps[remat] = make_train_step(cfg, optimizer, masked_loss_fraction=masked_fraction)
+        return _steps[remat]
 
     def _is_oom(error: Exception) -> bool:
         text = str(error)
         return "RESOURCE_EXHAUSTED" in text or "out of memory" in text.lower()
 
-    def measure(batch_size: int, num_steps: int):
+    def measure(batch_size: int, num_steps: int, remat: bool = False):
         """Throughput of one config; fresh state each time (buffers are donated)."""
+        model, train_step = get_step(remat)
         batch = make_synthetic_mlm_batch(jax.random.PRNGKey(0), config, batch_size, seq_len)
         params = model.init(jax.random.PRNGKey(1), batch["input_ids"][:1, :8])["params"]
         opt_state = optimizer.init(params)
@@ -132,26 +140,42 @@ def main() -> None:
         return batch_size * seq_len * num_steps / elapsed, float(loss)
 
     if on_tpu:
-        # auto-tune the batch size on the actual chip: the MXU/HBM sweet spot
-        # varies by generation; a short probe per candidate, then the full run
+        # auto-tune (batch size, remat) on the actual chip: the MXU/HBM sweet spot
+        # varies by generation. Plain candidates ascend until OOM; remat trades
+        # recompute FLOPs for activation memory, so it unlocks the larger batches —
+        # probe it from the last plain size upward and keep whichever wins.
         best = None
+        plain_limit = None
         for candidate in (32, 64, 128, 256):
             try:
-                tps, _ = measure(candidate, num_steps=5)
+                tps, _ = measure(candidate, num_steps=5, remat=False)
             except Exception as e:
                 if _is_oom(e):
-                    break  # larger candidates will also fail
+                    plain_limit = candidate
+                    break  # larger plain candidates will also fail
                 print(f"# batch {candidate} probe failed (non-OOM), skipping: {e!r}",
                       file=__import__("sys").stderr)
                 continue
             if best is None or tps > best[1]:
-                best = (candidate, tps)
-        batch_size = best[0] if best is not None else 32
+                best = (candidate, tps, False)
+        remat_start = plain_limit if plain_limit is not None else 256
+        for candidate in (c for c in (128, 256, 512) if c >= remat_start):
+            try:
+                tps, _ = measure(candidate, num_steps=5, remat=True)
+            except Exception as e:
+                if _is_oom(e):
+                    break
+                print(f"# remat batch {candidate} probe failed (non-OOM), skipping: {e!r}",
+                      file=__import__("sys").stderr)
+                continue
+            if best is None or tps > best[1]:
+                best = (candidate, tps, True)
+        batch_size, _, use_remat = best if best is not None else (32, 0.0, False)
         num_steps = 20
     else:
-        batch_size, num_steps = 4, 5
+        batch_size, num_steps, use_remat = 4, 5, False
 
-    tokens_per_sec, final_loss = measure(batch_size, num_steps)
+    tokens_per_sec, final_loss = measure(batch_size, num_steps, remat=use_remat)
     loss = final_loss
     averaging = _averaging_gbps()
 
@@ -162,6 +186,7 @@ def main() -> None:
         "extra": {
             "device": str(getattr(device, "device_kind", device.platform)),
             "batch_size": batch_size,
+            "remat": use_remat,
             "seq_len": seq_len,
             "final_loss": round(float(loss), 4),
             "averaging_gbps_per_peer": (averaging or {}).get("value"),

@@ -31,6 +31,7 @@ class AlbertConfig:
     intermediate_size: int = 3072
     max_position: int = 512
     dtype: Any = jnp.bfloat16
+    remat: bool = False  # checkpoint each shared-layer application (see setup)
     # sequence parallelism: when mesh is set and its 'sp' axis > 1, attention runs as
     # ring attention sharded over the sequence (mask support: full sequences only)
     mesh: Optional[Any] = None
@@ -112,7 +113,12 @@ class AlbertForMaskedLM(nn.Module):
         self.embedding_projection = nn.Dense(
             cfg.hidden_size, dtype=cfg.dtype, param_dtype=jnp.float32, name="embedding_projection"
         )
-        self.shared_layer = AlbertLayer(cfg, name="shared_layer")
+        # remat: recompute each shared-layer application's activations in the backward
+        # pass instead of keeping them in HBM for the whole step — buys batch size when
+        # the step is memory-bound (the classic single-chip MFU lever). The module name
+        # is pinned so the parameter tree is identical either way.
+        layer_cls = nn.remat(AlbertLayer) if cfg.remat else AlbertLayer
+        self.shared_layer = layer_cls(cfg, name="shared_layer")
         self.mlm_transform = nn.Dense(
             cfg.embedding_size, dtype=cfg.dtype, param_dtype=jnp.float32, name="mlm_transform"
         )

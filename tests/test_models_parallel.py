@@ -134,6 +134,34 @@ def test_masked_only_loss_equals_full_loss():
         )
 
 
+def test_remat_training_step_matches_plain():
+    """remat=True must be numerically identical (same params, same math, only the
+    backward-pass activation strategy changes) — it is purely a memory/batch lever."""
+    import optax
+
+    from hivemind_tpu.models import AlbertConfig, make_synthetic_mlm_batch, make_train_step
+
+    results = {}
+    for remat in (False, True):
+        config = AlbertConfig.tiny(max_position=64, remat=remat)
+        model, step = make_train_step(config, optax.sgd(0.1))
+        batch = make_synthetic_mlm_batch(jax.random.PRNGKey(0), config, 4, 64)
+        params = model.init(jax.random.PRNGKey(1), batch["input_ids"][:1, :8])["params"]
+        opt_state = optax.sgd(0.1).init(params)
+        loss, new_params, _ = jax.jit(step)(params, opt_state, batch)
+        results[remat] = (float(loss), new_params)
+
+    assert results[False][0] == results[True][0], "remat changed the loss"
+    for plain_leaf, remat_leaf in zip(
+        jax.tree_util.tree_leaves(results[False][1]), jax.tree_util.tree_leaves(results[True][1])
+    ):
+        # the recompute changes XLA fusion boundaries, so bf16 rounding in the
+        # backward pass differs slightly; the training signal must still agree
+        np.testing.assert_allclose(
+            np.asarray(plain_leaf), np.asarray(remat_leaf), rtol=0.05, atol=1e-3
+        )
+
+
 def test_pallas_flash_attention_matches_plain():
     """Fused flash kernel (interpret mode on CPU) == reference einsum attention,
     bidirectional + causal, including a seq that is not a block multiple, and
