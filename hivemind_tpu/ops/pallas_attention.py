@@ -38,7 +38,7 @@ _NEG_INF = -1e30  # large-but-finite: keeps fully-masked rows NaN-free
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, out_ref, max_ref, sum_ref, acc_ref, *, seq_len: int, causal: bool
+    q_ref, k_ref, v_ref, out_ref, lse_ref, max_ref, sum_ref, acc_ref, *, seq_len: int, causal: bool
 ):
     """One (query block, KV block) grid step; carry persists in scratch refs."""
     q_index, kv_index = pl.program_id(1), pl.program_id(2)
@@ -88,6 +88,9 @@ def _flash_kernel(
     def _finalize():
         out = acc_ref[:] / jnp.maximum(sum_ref[:, 0], 1e-30)[:, None]
         out_ref[0] = out.astype(out_ref.dtype)
+        # log-sum-exp per query row: what ring attention needs to merge softmax
+        # statistics across sequence shards without re-materializing the scores
+        lse_ref[0] = max_ref[:, 0] + jnp.log(jnp.maximum(sum_ref[:, 0], 1e-30))
 
 
 @partial(jax.jit, static_argnames=("causal", "interpret"))
@@ -102,7 +105,7 @@ def _flash_forward(q, k, v, causal: bool = False, interpret: bool = False):
 
     qb = to_bh(q, BLOCK_Q)
     kb, vb = to_bh(k, BLOCK_K), to_bh(v, BLOCK_K)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         partial(_flash_kernel, seq_len=seq, causal=causal),
         grid=(batch * heads, qb.shape[1] // BLOCK_Q, kb.shape[1] // BLOCK_K),
         in_specs=[
@@ -110,8 +113,14 @@ def _flash_forward(q, k, v, causal: bool = False, interpret: bool = False):
             pl.BlockSpec((1, BLOCK_K, head_dim), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, BLOCK_K, head_dim), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, BLOCK_Q, head_dim), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((batch * heads, qb.shape[1], head_dim), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, BLOCK_Q, head_dim), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, BLOCK_Q), lambda bh, qi, ki: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch * heads, qb.shape[1], head_dim), q.dtype),
+            jax.ShapeDtypeStruct((batch * heads, qb.shape[1]), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((BLOCK_Q, 1), jnp.float32),  # running row max
             pltpu.VMEM((BLOCK_Q, 1), jnp.float32),  # running row sum
@@ -120,18 +129,28 @@ def _flash_forward(q, k, v, causal: bool = False, interpret: bool = False):
         interpret=interpret,
     )(qb, kb, vb)
     out = out[:, :seq].reshape(batch, heads, seq, head_dim)
-    return jnp.transpose(out, (0, 2, 1, 3))
+    lse = lse[:, :seq].reshape(batch, heads, seq)
+    return jnp.transpose(out, (0, 2, 1, 3)), lse
+
+
+def flash_attention_lse(q, k, v, causal: bool = False, interpret: bool = False):
+    """Fused attention that ALSO returns the per-row log-sum-exp ([batch, heads,
+    seq], fp32) — the statistic ring attention needs to merge shard outputs:
+    ``merged = Σ_i out_i · exp(lse_i − logaddexp_i(lse))``. Forward-only (no
+    custom_vjp): callers that differentiate wrap the whole construction (see
+    `parallel.ring_attention.ring_flash_attention`)."""
+    return _flash_forward(q, k, v, causal=causal, interpret=interpret)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(q, k, v, causal: bool = False, interpret: bool = False):
     """Fused flash attention on [batch, seq, heads, head_dim] (full sequences; for
     padded batches use the mask-capable `plain_attention`). Grad = recompute."""
-    return _flash_forward(q, k, v, causal=causal, interpret=interpret)
+    return _flash_forward(q, k, v, causal=causal, interpret=interpret)[0]
 
 
 def _flash_fwd(q, k, v, causal, interpret):
-    return _flash_forward(q, k, v, causal=causal, interpret=interpret), (q, k, v)
+    return _flash_forward(q, k, v, causal=causal, interpret=interpret)[0], (q, k, v)
 
 
 def _flash_bwd(causal, interpret, residuals, grad_out):
