@@ -40,7 +40,7 @@ def peak_flops(device) -> float:
     return 197e12  # default: v5e-class
 
 
-def _tpu_reachable(attempts: int = 3, timeout: float = 150.0) -> bool:
+def _tpu_reachable(attempts: int = 4, timeout: float = 150.0) -> bool:
     """Probe TPU initialization in a SUBPROCESS: if the accelerator tunnel is wedged,
     jax.devices() hangs forever and would take the whole benchmark (and its driver)
     with it. A hung probe is killed and retried with backoff (a busy tunnel often

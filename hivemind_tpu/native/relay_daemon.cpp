@@ -16,14 +16,17 @@
 //             stale control line for the same peer_id — only the key owner can, so
 //             a NAT-rebound peer reclaims its identity immediately instead of
 //             waiting for TCP keepalive to reap the dead line.
-//             Known limitation: the proof does not authenticate the RELAY, so a
-//             malicious relay the victim actively registers through can proxy the
-//             live challenge from another relay and capture the victim's
-//             registration THERE (availability only: dialers still authenticate the
-//             target end-to-end via Noise, so a captured INCOMING cannot be
-//             answered convincingly — the dial just fails). Closing it requires a
-//             relay keypair + encrypted control line (Noise to a pinned relay id);
-//             message-binding schemes don't survive a transparent-proxy relay.
+//   HANDSHAKE 'H' <32B client X25519 eph>  -> 'S' <32B relay eph> <32B relay
+//             Ed25519 pub> <64B sig over "hivemind-relay-hs:" + client_eph +
+//             relay_eph>. Derives per-direction ChaCha20-Poly1305 keys
+//             (HKDF-SHA256 of the ECDH secret, salt "hivemind-relay-hs", info
+//             "control"; nonce = 4 zero bytes + LE64 counter); every later control
+//             frame on the conn is sealed, so INCOMING tokens and registration
+//             proofs are opaque to on-path observers. Clients that PIN the relay
+//             identity also defeat a malicious relay proxying the registration
+//             challenge to a second relay (the proxy cannot read or re-wrap the
+//             sealed proof); unpinned trust-on-first-use still leaves that window
+//             on the very first connect, like SSH.
 //   DIAL      'D' <16B token> <target_id>-> 'O' then splice  (sent on a FRESH conn)
 //   ACCEPT    'A' <16B token>            -> 'O' then splice  (fresh conn from target)
 //   INCOMING  'I' <16B token>            relay -> target's control line
@@ -89,6 +92,42 @@ static int (*digest_verify_init)(EVP_MD_CTX*, void**, const void*, void*, EVP_PK
 static int (*digest_verify)(EVP_MD_CTX*, const unsigned char*, size_t, const unsigned char*, size_t) = nullptr;
 static unsigned char* (*sha256_fn)(const unsigned char*, size_t, unsigned char*) = nullptr;
 
+// additional entry points for the encrypted control channel (X25519 ECDH +
+// Ed25519 relay identity + HKDF-SHA256 + ChaCha20-Poly1305 AEAD)
+typedef struct evp_pkey_ctx_st EVP_PKEY_CTX;
+typedef struct evp_cipher_ctx_st EVP_CIPHER_CTX;
+typedef struct evp_cipher_st EVP_CIPHER;
+typedef struct evp_md_st EVP_MD;
+static constexpr int EVP_PKEY_X25519 = 1034;  // NID_X25519
+static constexpr int CTRL_AEAD_GET_TAG = 0x10, CTRL_AEAD_SET_TAG = 0x11;
+
+static EVP_PKEY_CTX* (*pkey_ctx_new_id)(int, void*) = nullptr;
+static void (*pkey_ctx_free)(EVP_PKEY_CTX*) = nullptr;
+static int (*keygen_init)(EVP_PKEY_CTX*) = nullptr;
+static int (*keygen)(EVP_PKEY_CTX*, EVP_PKEY**) = nullptr;
+static int (*get_raw_public_key)(const EVP_PKEY*, unsigned char*, size_t*) = nullptr;
+static int (*digest_sign_init)(EVP_MD_CTX*, EVP_PKEY_CTX**, const EVP_MD*, void*, EVP_PKEY*) = nullptr;
+static int (*digest_sign)(EVP_MD_CTX*, unsigned char*, size_t*, const unsigned char*, size_t) = nullptr;
+static int (*derive_init)(EVP_PKEY_CTX*) = nullptr;
+static int (*derive_set_peer)(EVP_PKEY_CTX*, EVP_PKEY*) = nullptr;
+static int (*derive)(EVP_PKEY_CTX*, unsigned char*, size_t*) = nullptr;
+static EVP_PKEY_CTX* (*pkey_ctx_new)(EVP_PKEY*, void*) = nullptr;
+static unsigned char* (*hmac_fn)(const EVP_MD*, const void*, int, const unsigned char*, size_t,
+                                 unsigned char*, unsigned int*) = nullptr;
+static const EVP_MD* (*sha256_md)() = nullptr;
+static EVP_CIPHER_CTX* (*cipher_ctx_new)() = nullptr;
+static void (*cipher_ctx_free)(EVP_CIPHER_CTX*) = nullptr;
+static const EVP_CIPHER* (*chacha20_poly1305)() = nullptr;
+static int (*encrypt_init)(EVP_CIPHER_CTX*, const EVP_CIPHER*, void*, const unsigned char*, const unsigned char*) = nullptr;
+static int (*encrypt_update)(EVP_CIPHER_CTX*, unsigned char*, int*, const unsigned char*, int) = nullptr;
+static int (*encrypt_final)(EVP_CIPHER_CTX*, unsigned char*, int*) = nullptr;
+static int (*decrypt_init)(EVP_CIPHER_CTX*, const EVP_CIPHER*, void*, const unsigned char*, const unsigned char*) = nullptr;
+static int (*decrypt_update)(EVP_CIPHER_CTX*, unsigned char*, int*, const unsigned char*, int) = nullptr;
+static int (*decrypt_final)(EVP_CIPHER_CTX*, unsigned char*, int*) = nullptr;
+static int (*cipher_ctx_ctrl)(EVP_CIPHER_CTX*, int, int, void*) = nullptr;
+
+static bool channel_available = false;  // handshake ops resolved
+
 static bool load() {
   void* lib = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_LOCAL);
   if (!lib) lib = dlopen("libcrypto.so", RTLD_NOW | RTLD_LOCAL);
@@ -100,6 +139,37 @@ static bool load() {
   digest_verify_init = (decltype(digest_verify_init))dlsym(lib, "EVP_DigestVerifyInit");
   digest_verify = (decltype(digest_verify))dlsym(lib, "EVP_DigestVerify");
   sha256_fn = (decltype(sha256_fn))dlsym(lib, "SHA256");
+
+  pkey_ctx_new_id = (decltype(pkey_ctx_new_id))dlsym(lib, "EVP_PKEY_CTX_new_id");
+  pkey_ctx_free = (decltype(pkey_ctx_free))dlsym(lib, "EVP_PKEY_CTX_free");
+  keygen_init = (decltype(keygen_init))dlsym(lib, "EVP_PKEY_keygen_init");
+  keygen = (decltype(keygen))dlsym(lib, "EVP_PKEY_keygen");
+  get_raw_public_key = (decltype(get_raw_public_key))dlsym(lib, "EVP_PKEY_get_raw_public_key");
+  digest_sign_init = (decltype(digest_sign_init))dlsym(lib, "EVP_DigestSignInit");
+  digest_sign = (decltype(digest_sign))dlsym(lib, "EVP_DigestSign");
+  derive_init = (decltype(derive_init))dlsym(lib, "EVP_PKEY_derive_init");
+  derive_set_peer = (decltype(derive_set_peer))dlsym(lib, "EVP_PKEY_derive_set_peer");
+  derive = (decltype(derive))dlsym(lib, "EVP_PKEY_derive");
+  pkey_ctx_new = (decltype(pkey_ctx_new))dlsym(lib, "EVP_PKEY_CTX_new");
+  hmac_fn = (decltype(hmac_fn))dlsym(lib, "HMAC");
+  sha256_md = (decltype(sha256_md))dlsym(lib, "EVP_sha256");
+  cipher_ctx_new = (decltype(cipher_ctx_new))dlsym(lib, "EVP_CIPHER_CTX_new");
+  cipher_ctx_free = (decltype(cipher_ctx_free))dlsym(lib, "EVP_CIPHER_CTX_free");
+  chacha20_poly1305 = (decltype(chacha20_poly1305))dlsym(lib, "EVP_chacha20_poly1305");
+  encrypt_init = (decltype(encrypt_init))dlsym(lib, "EVP_EncryptInit_ex");
+  encrypt_update = (decltype(encrypt_update))dlsym(lib, "EVP_EncryptUpdate");
+  encrypt_final = (decltype(encrypt_final))dlsym(lib, "EVP_EncryptFinal_ex");
+  decrypt_init = (decltype(decrypt_init))dlsym(lib, "EVP_DecryptInit_ex");
+  decrypt_update = (decltype(decrypt_update))dlsym(lib, "EVP_DecryptUpdate");
+  decrypt_final = (decltype(decrypt_final))dlsym(lib, "EVP_DecryptFinal_ex");
+  cipher_ctx_ctrl = (decltype(cipher_ctx_ctrl))dlsym(lib, "EVP_CIPHER_CTX_ctrl");
+
+  channel_available = pkey_ctx_new_id && pkey_ctx_free && keygen_init && keygen &&
+                      get_raw_public_key && digest_sign_init && digest_sign && derive_init &&
+                      derive_set_peer && derive && pkey_ctx_new && hmac_fn && sha256_md &&
+                      cipher_ctx_new && cipher_ctx_free && chacha20_poly1305 && encrypt_init &&
+                      encrypt_update && encrypt_final && decrypt_init && decrypt_update &&
+                      decrypt_final && cipher_ctx_ctrl;
   return new_raw_public_key && pkey_free && md_ctx_new && md_ctx_free &&
          digest_verify_init && digest_verify && sha256_fn;
 }
@@ -126,6 +196,114 @@ static bool ed25519_verify(const std::string& pubkey_raw, const std::string& mes
   if (ctx) md_ctx_free(ctx);
   pkey_free(key);
   return ok;
+}
+static EVP_PKEY* generate_key(int type) {
+  EVP_PKEY_CTX* ctx = pkey_ctx_new_id(type, nullptr);
+  if (!ctx) return nullptr;
+  EVP_PKEY* key = nullptr;
+  if (keygen_init(ctx) != 1 || keygen(ctx, &key) != 1) key = nullptr;
+  pkey_ctx_free(ctx);
+  return key;
+}
+
+static bool raw_public(EVP_PKEY* key, unsigned char out[32]) {
+  size_t len = 32;
+  return get_raw_public_key(key, out, &len) == 1 && len == 32;
+}
+
+static bool ed25519_sign(EVP_PKEY* key, const std::string& message, unsigned char sig[64]) {
+  EVP_MD_CTX* ctx = md_ctx_new();
+  if (!ctx) return false;
+  size_t siglen = 64;
+  bool ok = digest_sign_init(ctx, nullptr, nullptr, nullptr, key) == 1 &&
+            digest_sign(ctx, sig, &siglen, (const unsigned char*)message.data(),
+                        message.size()) == 1 &&
+            siglen == 64;
+  md_ctx_free(ctx);
+  return ok;
+}
+
+static bool x25519_shared(EVP_PKEY* own, const unsigned char peer_pub[32],
+                          unsigned char out[32]) {
+  EVP_PKEY* peer = new_raw_public_key(EVP_PKEY_X25519, nullptr, peer_pub, 32);
+  if (!peer) return false;
+  EVP_PKEY_CTX* ctx = pkey_ctx_new(own, nullptr);
+  size_t len = 32;
+  bool ok = ctx && derive_init(ctx) == 1 && derive_set_peer(ctx, peer) == 1 &&
+            derive(ctx, out, &len) == 1 && len == 32;
+  if (ctx) pkey_ctx_free(ctx);
+  pkey_free(peer);
+  return ok;
+}
+
+// HKDF-SHA256 (RFC 5869), 64-byte output — matches the Python client's HKDF call
+static bool hkdf64(const unsigned char ikm[32], const std::string& salt,
+                   const std::string& info, unsigned char out[64]) {
+  unsigned char prk[32];
+  unsigned int prk_len = 32;
+  if (!hmac_fn(sha256_md(), salt.data(), (int)salt.size(), ikm, 32, prk, &prk_len)) return false;
+  std::string t1_input = info + '\x01';
+  unsigned int block_len = 32;
+  if (!hmac_fn(sha256_md(), prk, 32, (const unsigned char*)t1_input.data(), t1_input.size(),
+               out, &block_len))
+    return false;
+  std::string t2_input((char*)out, 32);
+  t2_input += info;
+  t2_input += '\x02';
+  if (!hmac_fn(sha256_md(), prk, 32, (const unsigned char*)t2_input.data(), t2_input.size(),
+               out + 32, &block_len))
+    return false;
+  return true;
+}
+
+// ChaCha20-Poly1305 seal/open; nonce = 4 zero bytes + LE64 counter, tag appended
+static bool aead_seal(const unsigned char key[32], uint64_t counter,
+                      const std::string& plaintext, std::string& out) {
+  unsigned char nonce[12] = {0};
+  memcpy(nonce + 4, &counter, 8);  // little-endian on all supported targets
+  EVP_CIPHER_CTX* ctx = cipher_ctx_new();
+  if (!ctx) return false;
+  out.resize(plaintext.size() + 16);
+  int len = 0, total = 0;
+  bool ok = encrypt_init(ctx, chacha20_poly1305(), nullptr, key, nonce) == 1;
+  if (ok && !plaintext.empty()) {
+    ok = encrypt_update(ctx, (unsigned char*)&out[0], &len,
+                        (const unsigned char*)plaintext.data(), (int)plaintext.size()) == 1;
+    total = len;
+  }
+  ok = ok && encrypt_final(ctx, (unsigned char*)&out[0] + total, &len) == 1;
+  total += len;
+  ok = ok && cipher_ctx_ctrl(ctx, CTRL_AEAD_GET_TAG, 16, &out[total]) == 1;
+  cipher_ctx_free(ctx);
+  if (!ok) return false;
+  out.resize(total + 16);
+  return true;
+}
+
+static bool aead_open(const unsigned char key[32], uint64_t counter,
+                      const std::string& ciphertext, std::string& out) {
+  if (ciphertext.size() < 16) return false;
+  unsigned char nonce[12] = {0};
+  memcpy(nonce + 4, &counter, 8);
+  EVP_CIPHER_CTX* ctx = cipher_ctx_new();
+  if (!ctx) return false;
+  size_t body = ciphertext.size() - 16;
+  out.resize(body);
+  int len = 0, total = 0;
+  bool ok = decrypt_init(ctx, chacha20_poly1305(), nullptr, key, nonce) == 1;
+  ok = ok && cipher_ctx_ctrl(ctx, CTRL_AEAD_SET_TAG, 16, (void*)(ciphertext.data() + body)) == 1;
+  if (ok && body) {
+    ok = decrypt_update(ctx, (unsigned char*)&out[0], &len, (const unsigned char*)ciphertext.data(),
+                        (int)body) == 1;
+    total = len;
+  }
+  unsigned char scratch[16];  // AEAD final emits no bytes; it only checks the tag
+  len = 0;
+  ok = ok && decrypt_final(ctx, scratch, &len) == 1;
+  cipher_ctx_free(ctx);
+  if (!ok) return false;
+  out.resize(total + len);
+  return true;
 }
 }  // namespace relay_crypto
 
@@ -155,6 +333,10 @@ struct Conn {
   std::string pending_peer_id;  // REGISTER received, awaiting Ed25519 proof
   std::string challenge;        // 32B nonce the proof must sign
   std::string token;        // set for pending dial/accept conns
+  // encrypted control channel ('H' handshake): per-direction ChaCha20-Poly1305
+  bool enc = false;
+  unsigned char send_key[32] = {0}, recv_key[32] = {0};
+  uint64_t send_ctr = 0, recv_ctr = 0;
   int peer_fd = -1;         // spliced counterpart
   double created_ms = 0;
   bool want_write = false;
@@ -163,6 +345,8 @@ struct Conn {
 };
 
 static int g_epoll = -1;
+static relay_crypto::EVP_PKEY* g_relay_identity = nullptr;  // Ed25519, fresh per run
+static unsigned char g_relay_pub[32] = {0};
 static std::map<int, Conn*> g_conns;
 static std::map<std::string, int> g_control;        // peer_id -> control fd
 static std::map<std::string, int> g_pending_dials;  // token -> dialer fd
@@ -190,9 +374,18 @@ static void queue_write(Conn* c, const char* data, size_t len) {
 }
 
 static void queue_frame(Conn* c, const std::string& payload) {
-  uint32_t n = htonl((uint32_t)payload.size());
+  std::string body = payload;
+  if (c->enc) {
+    std::string sealed;
+    if (!relay_crypto::aead_seal(c->send_key, c->send_ctr++, payload, sealed)) {
+      close_conn(c->fd);
+      return;
+    }
+    body.swap(sealed);
+  }
+  uint32_t n = htonl((uint32_t)body.size());
   std::string frame((char*)&n, 4);
-  frame += payload;
+  frame += body;
   queue_write(c, frame.data(), frame.size());
 }
 
@@ -247,9 +440,16 @@ static void splice_pair(Conn* a, Conn* b) {
   a->state = b->state = ConnState::Spliced;
   enable_keepalive(a->fd);
   enable_keepalive(b->fd);
-  const char ok[] = {0, 0, 0, 1, 'O'};
-  queue_write(a, ok, 5);
-  queue_write(b, ok, 5);
+  // 'O' goes out under whatever framing each side negotiated; after it, both
+  // sockets are a raw byte pipe (the peers' own end-to-end Noise takes over).
+  // queue_frame can close a conn on an AEAD-seal failure — re-check liveness
+  // before touching either side again (close_conn also tears down the partner).
+  int a_fd = a->fd, b_fd = b->fd;
+  queue_frame(a, "O");
+  if (g_conns.find(a_fd) == g_conns.end()) return;
+  queue_frame(b, "O");
+  if (g_conns.find(b_fd) == g_conns.end()) return;
+  a->enc = b->enc = false;
   // any bytes that raced ahead of the match are forwarded
   if (!a->inbuf.empty()) { queue_write(b, a->inbuf.data(), a->inbuf.size()); a->inbuf.clear(); }
   if (!b->inbuf.empty()) { queue_write(a, b->inbuf.data(), b->inbuf.size()); b->inbuf.clear(); }
@@ -268,7 +468,38 @@ static void refuse_and_close(Conn* c) {
 static void handle_control_frame(Conn* c, const std::string& payload) {
   if (payload.empty()) { close_conn(c->fd); return; }
   char kind = payload[0];
-  if (kind == 'R') {
+  if (kind == 'H') {
+    // Channel handshake: 'H' + client X25519 ephemeral(32) ->
+    // 'S' + relay ephemeral(32) + relay Ed25519 pub(32) + sig(64) over
+    // "hivemind-relay-hs:" + client_eph + relay_eph. All later frames on this
+    // conn are ChaCha20-Poly1305 sealed (keys = HKDF-SHA256 of the ECDH secret),
+    // so INCOMING tokens and registration proofs are opaque to on-path observers,
+    // and pinning the relay pub on the client defeats a proxying relay.
+    if (c->enc || !relay_crypto::channel_available || g_relay_identity == nullptr ||
+        payload.size() != 1 + 32) {
+      refuse_and_close(c);
+      return;
+    }
+    relay_crypto::EVP_PKEY* eph = relay_crypto::generate_key(relay_crypto::EVP_PKEY_X25519);
+    unsigned char eph_pub[32], shared[32], okm[64], sig[64];
+    bool ok = eph != nullptr && relay_crypto::raw_public(eph, eph_pub) &&
+              relay_crypto::x25519_shared(eph, (const unsigned char*)payload.data() + 1, shared);
+    if (ok) {
+      std::string transcript = "hivemind-relay-hs:" + payload.substr(1, 32) +
+                               std::string((char*)eph_pub, 32);
+      ok = relay_crypto::hkdf64(shared, "hivemind-relay-hs", "control", okm) &&
+           relay_crypto::ed25519_sign(g_relay_identity, transcript, sig);
+    }
+    if (eph) relay_crypto::pkey_free(eph);
+    if (!ok) { refuse_and_close(c); return; }
+    std::string reply = "S" + std::string((char*)eph_pub, 32) +
+                        std::string((char*)g_relay_pub, 32) + std::string((char*)sig, 64);
+    queue_frame(c, reply);  // plaintext: the client derives keys from this reply
+    memcpy(c->recv_key, okm, 32);       // client -> relay
+    memcpy(c->send_key, okm + 32, 32);  // relay -> client
+    c->send_ctr = c->recv_ctr = 0;
+    c->enc = true;
+  } else if (kind == 'R') {
     std::string peer_id = payload.substr(1);
     if (peer_id.empty()) { close_conn(c->fd); return; }
     if (relay_crypto::available) {
@@ -395,6 +626,14 @@ static void on_readable(Conn* c) {
         if (c->inbuf.size() < 4 + len) break;
         std::string payload = c->inbuf.substr(4, len);
         c->inbuf.erase(0, 4 + len);
+        if (c->enc) {
+          std::string opened;
+          if (!relay_crypto::aead_open(c->recv_key, c->recv_ctr++, payload, opened)) {
+            close_conn(c->fd);  // tampered/replayed frame: drop the connection
+            return;
+          }
+          payload.swap(opened);
+        }
         handle_control_frame(c, payload);
         if (g_conns.find(c->fd) == g_conns.end()) return;  // frame handler closed us
         if (c->closing_after_flush) return;  // refused: flush 'E', ignore further input
@@ -434,6 +673,13 @@ int main(int argc, char** argv) {
   relay_crypto::available = relay_crypto::load();
   if (!relay_crypto::available)
     fprintf(stderr, "relay: libcrypto unavailable, registrations are UNAUTHENTICATED\n");
+  if (relay_crypto::channel_available) {
+    g_relay_identity = relay_crypto::generate_key(relay_crypto::EVP_PKEY_ED25519);
+    if (g_relay_identity != nullptr && !relay_crypto::raw_public(g_relay_identity, g_relay_pub)) {
+      g_relay_identity = nullptr;
+      fprintf(stderr, "relay: identity keygen failed, encrypted control disabled\n");
+    }
+  }
 
   int listener = socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;
@@ -449,6 +695,11 @@ int main(int argc, char** argv) {
   socklen_t alen = sizeof(addr);
   getsockname(listener, (sockaddr*)&addr, &alen);
   printf("relay listening on port %d\n", ntohs(addr.sin_port));
+  if (g_relay_identity != nullptr) {
+    char hex[65];
+    for (int i = 0; i < 32; i++) snprintf(hex + 2 * i, 3, "%02x", g_relay_pub[i]);
+    printf("relay identity %s\n", hex);
+  }
   fflush(stdout);
 
   g_epoll = epoll_create1(0);

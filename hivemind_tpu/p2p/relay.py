@@ -3,7 +3,13 @@ circuit-relay capability: a firewalled peer registers over an OUTBOUND connectio
 becomes dialable as ``/ip4/<relay>/tcp/<port>/p2p-circuit/p2p/<peer>`` (role parity:
 reference p2p_daemon.py:114-137 auto-relay). The relay splices raw bytes; the normal
 end-to-end Noise handshake runs straight through it, so the relay never sees
-plaintext."""
+plaintext.
+
+Control traffic (REGISTER/PROOF/DIAL/ACCEPT/INCOMING/WHOAMI) additionally runs over
+an encrypted channel to the relay itself: an 'H' handshake (X25519 ECDH, relay
+Ed25519 identity signature, HKDF-SHA256 keys, ChaCha20-Poly1305 frames) keeps dial
+tokens and registration proofs opaque to on-path observers, and pinning the relay's
+identity (``relay_pubkey=``) defeats a proxying relay replaying proofs elsewhere."""
 
 from __future__ import annotations
 
@@ -13,12 +19,21 @@ import os
 import struct
 from typing import Optional, Tuple
 
+from cryptography.exceptions import InvalidSignature, InvalidTag
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ed25519 as raw_ed25519
+from cryptography.hazmat.primitives.asymmetric.x25519 import X25519PrivateKey, X25519PublicKey
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
 from hivemind_tpu.p2p.crypto_channel import handshake
 from hivemind_tpu.p2p.mux import MuxConnection
 from hivemind_tpu.p2p.peer_id import PeerID
 from hivemind_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
+
+_HS_PREFIX = b"hivemind-relay-hs:"
 
 
 async def _send_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
@@ -32,9 +47,98 @@ async def _recv_frame(reader: asyncio.StreamReader) -> bytes:
     return await reader.readexactly(length)
 
 
-async def register_control(
-    reader: asyncio.StreamReader, writer: asyncio.StreamWriter, peer_id_bytes: bytes, identity
-) -> bytes:
+class RelayChannel:
+    """Control-frame transport to the relay: sealed (post-'H' handshake) or
+    plaintext (legacy daemon without libcrypto). ``relay_pubkey`` is the verified
+    relay identity (raw 32 bytes) when sealed, else None."""
+
+    def __init__(self, reader, writer, send_key=None, recv_key=None, relay_pubkey=None):
+        self.reader, self.writer = reader, writer
+        self._send_aead = ChaCha20Poly1305(send_key) if send_key is not None else None
+        self._recv_aead = ChaCha20Poly1305(recv_key) if recv_key is not None else None
+        self._send_ctr = 0
+        self._recv_ctr = 0
+        self.relay_pubkey = relay_pubkey
+
+    @property
+    def encrypted(self) -> bool:
+        return self._send_aead is not None
+
+    async def send_frame(self, payload: bytes) -> None:
+        if self._send_aead is not None:
+            nonce = struct.pack("<4xQ", self._send_ctr)
+            self._send_ctr += 1
+            payload = self._send_aead.encrypt(nonce, payload, None)
+        await _send_frame(self.writer, payload)
+
+    async def recv_frame(self) -> bytes:
+        payload = await _recv_frame(self.reader)
+        if self._recv_aead is not None:
+            nonce = struct.pack("<4xQ", self._recv_ctr)
+            self._recv_ctr += 1
+            try:
+                payload = self._recv_aead.decrypt(nonce, payload, None)
+            except InvalidTag:
+                # surface as a connection failure so every caller's existing
+                # (ConnectionError, ...) handling applies — a tampered or
+                # desynced frame means the channel is dead either way
+                raise ConnectionError("relay control frame failed AEAD authentication") from None
+        return payload
+
+    def close(self) -> None:
+        with contextlib.suppress(Exception):
+            self.writer.close()
+
+
+async def open_relay_channel(
+    host: str, port: int, relay_pubkey: Optional[bytes] = None
+) -> RelayChannel:
+    """Connect and negotiate the encrypted control channel. Falls back to plaintext
+    only when the daemon cannot do crypto AND no ``relay_pubkey`` pin was given."""
+    reader, writer = await asyncio.open_connection(host, port)
+    ephemeral = X25519PrivateKey.generate()
+    eph_pub = ephemeral.public_key().public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    )
+    legacy = False
+    try:
+        await _send_frame(writer, b"H" + eph_pub)
+        response = await _recv_frame(reader)
+        if response[:1] != b"S" or len(response) != 129:
+            legacy = True
+    except (asyncio.IncompleteReadError, ConnectionError, OSError):
+        legacy = True  # pre-handshake daemon closes on the unknown 'H' frame
+    if legacy:
+        with contextlib.suppress(Exception):
+            writer.close()
+        if relay_pubkey is not None:
+            raise ConnectionError("relay does not support the encrypted control channel "
+                                  "but a pinned identity was required")
+        reader, writer = await asyncio.open_connection(host, port)
+        return RelayChannel(reader, writer)
+
+    relay_eph, relay_pub, signature = response[1:33], response[33:65], response[65:129]
+    try:
+        raw_ed25519.Ed25519PublicKey.from_public_bytes(relay_pub).verify(
+            signature, _HS_PREFIX + eph_pub + relay_eph
+        )
+    except InvalidSignature:
+        writer.close()
+        raise ConnectionError("relay failed its identity proof") from None
+    if relay_pubkey is not None and relay_pub != relay_pubkey:
+        writer.close()
+        raise ConnectionError(
+            f"relay identity mismatch: expected {relay_pubkey.hex()}, got {relay_pub.hex()}"
+        )
+    shared = ephemeral.exchange(X25519PublicKey.from_public_bytes(relay_eph))
+    okm = HKDF(
+        algorithm=hashes.SHA256(), length=64, salt=b"hivemind-relay-hs", info=b"control"
+    ).derive(shared)
+    # client->relay key first, relay->client second (must mirror the daemon)
+    return RelayChannel(reader, writer, send_key=okm[:32], recv_key=okm[32:], relay_pubkey=relay_pub)
+
+
+async def register_control(channel: RelayChannel, peer_id_bytes: bytes, identity) -> bytes:
     """Run the relay REGISTER exchange, answering an Ed25519 challenge if the daemon
     issues one ('C' + 32B nonce → 'P' + raw pubkey + raw signature over
     ``"hivemind-relay-register:" + challenge + peer_id``). Returns the final frame
@@ -42,15 +146,15 @@ async def register_control(
     line — only the key owner can evict a registration."""
     import base64
 
-    await _send_frame(writer, b"R" + peer_id_bytes)
-    response = await _recv_frame(reader)
+    await channel.send_frame(b"R" + peer_id_bytes)
+    response = await channel.recv_frame()
     if response[:1] == b"C":
         challenge = response[1:]
         message = b"hivemind-relay-register:" + challenge + peer_id_bytes
         signature = base64.b64decode(identity.sign(message))  # sign() returns base64
         pubkey = identity.get_public_key().to_bytes()
-        await _send_frame(writer, b"P" + pubkey + signature)
-        response = await _recv_frame(reader)
+        await channel.send_frame(b"P" + pubkey + signature)
+        response = await channel.recv_frame()
     return response
 
 
@@ -61,34 +165,46 @@ class RelayClient:
     relayed dials are accepted automatically and served like direct connections.
     ``dial(peer_id)`` connects to a registered peer through the relay."""
 
-    def __init__(self, p2p, host: str, port: int):
+    def __init__(self, p2p, host: str, port: int, relay_pubkey: Optional[bytes] = None):
         self.p2p = p2p
         self.host, self.port = host, port
-        self._control_writer: Optional[asyncio.StreamWriter] = None
+        if isinstance(relay_pubkey, str):
+            relay_pubkey = bytes.fromhex(relay_pubkey)
+        self.relay_pubkey = relay_pubkey  # optional pinned relay identity
+        self._control: Optional[RelayChannel] = None
         self._control_task: Optional[asyncio.Task] = None
 
     @classmethod
-    async def create(cls, p2p, host: str, port: int) -> "RelayClient":
-        self = cls(p2p, host, port)
+    async def create(cls, p2p, host: str, port: int, relay_pubkey: Optional[bytes] = None) -> "RelayClient":
+        self = cls(p2p, host, port, relay_pubkey=relay_pubkey)
         await self._register()
         return self
 
+    async def _open_channel(self) -> RelayChannel:
+        channel = await open_relay_channel(self.host, self.port, self.relay_pubkey)
+        if channel.encrypted and self.relay_pubkey is None:
+            # trust-on-first-use: pin the identity we saw so every later control
+            # connection in this client talks to the SAME relay
+            self.relay_pubkey = channel.relay_pubkey
+        return channel
+
     async def _register(self) -> None:
-        reader, writer = await asyncio.open_connection(self.host, self.port)
-        response = await register_control(
-            reader, writer, self.p2p.peer_id.to_bytes(), self.p2p.identity
-        )
+        channel = await self._open_channel()
+        response = await register_control(channel, self.p2p.peer_id.to_bytes(), self.p2p.identity)
         if response != b"O":
             raise ConnectionError(f"relay refused registration: {response!r}")
-        self._control_writer = writer
-        self._control_task = asyncio.create_task(self._control_loop(reader))
-        logger.info(f"registered at relay {self.host}:{self.port} as {self.p2p.peer_id}")
+        self._control = channel
+        self._control_task = asyncio.create_task(self._control_loop(channel))
+        mode = "encrypted" if channel.encrypted else "plaintext"
+        logger.info(
+            f"registered at relay {self.host}:{self.port} as {self.p2p.peer_id} ({mode} control)"
+        )
 
-    async def _control_loop(self, reader: asyncio.StreamReader) -> None:
+    async def _control_loop(self, channel: RelayChannel) -> None:
         """Wait for INCOMING notifications and accept each relayed dial."""
         try:
             while True:
-                frame = await _recv_frame(reader)
+                frame = await channel.recv_frame()
                 if frame[:1] == b"I" and len(frame) >= 17:
                     token = frame[1:17]
                     asyncio.create_task(self._accept(token))
@@ -97,34 +213,35 @@ class RelayClient:
 
     async def _accept(self, token: bytes) -> None:
         try:
-            reader, writer = await asyncio.open_connection(self.host, self.port)
-            await _send_frame(writer, b"A" + token)
-            response = await _recv_frame(reader)
+            channel = await self._open_channel()
+            await channel.send_frame(b"A" + token)
+            response = await channel.recv_frame()
             if response != b"O":
-                writer.close()
+                channel.close()
                 return
             # from here the socket is a transparent pipe to the dialer: run the
             # normal inbound path (handshake as responder, then mux)
-            await self.p2p._on_inbound_connection(reader, writer)
+            await self.p2p._on_inbound_connection(channel.reader, channel.writer)
         except Exception as e:
             logger.warning(f"relayed accept failed: {e!r}")
 
     async def dial(self, target: PeerID) -> PeerID:
         """Connect to a relay-registered peer; returns its authenticated PeerID and
         installs the connection in the P2P node like any direct dial."""
-        reader, writer = await asyncio.open_connection(self.host, self.port)
+        channel = await self._open_channel()
         token = os.urandom(16)
-        await _send_frame(writer, b"D" + token + target.to_bytes())
+        await channel.send_frame(b"D" + token + target.to_bytes())
         try:
-            response = await _recv_frame(reader)
+            response = await channel.recv_frame()
         except (asyncio.IncompleteReadError, ConnectionResetError):
             # the daemon may close right after its error frame; either way: no route
-            writer.close()
+            channel.close()
             raise ConnectionError(f"relay could not reach {target}") from None
         if response != b"O":
-            writer.close()
+            channel.close()
             raise ConnectionError(f"relay could not reach {target}: {response!r}")
-        channel, extras = await handshake(
+        reader, writer = channel.reader, channel.writer  # raw pipe from here on
+        noise_channel, extras = await handshake(
             reader, writer, self.p2p.identity, is_initiator=True,
             announced_addrs=self.p2p.get_visible_maddrs(),
         )
@@ -133,9 +250,11 @@ class RelayClient:
 
         peer_id = PeerID.from_public_key(Ed25519PublicKey.from_bytes(extras["static"]))
         if peer_id != target:
-            channel.close()
+            noise_channel.close()
             raise HandshakeError(f"dialed {target} via relay but found {peer_id}")
-        conn = MuxConnection(channel, peer_id, is_initiator=True, on_inbound_stream=self.p2p._route_stream)
+        conn = MuxConnection(
+            noise_channel, peer_id, is_initiator=True, on_inbound_stream=self.p2p._route_stream
+        )
         existing = self.p2p._connections.get(peer_id)
         if existing is None or existing.is_closed:
             self.p2p._connections[peer_id] = conn
@@ -146,20 +265,19 @@ class RelayClient:
     async def whoami(self) -> Tuple[str, int]:
         """The relay's view of our public endpoint (STUN-style observed address) —
         what a NATed peer advertises for hole punching."""
-        reader, writer = await asyncio.open_connection(self.host, self.port)
+        channel = await self._open_channel()
         try:
-            await _send_frame(writer, b"W")
-            response = await _recv_frame(reader)
+            await channel.send_frame(b"W")
+            response = await channel.recv_frame()
             if not response.startswith(b"O"):
                 raise ConnectionError(f"relay whoami failed: {response!r}")
             host, port = response[1:].decode().rsplit(":", 1)
             return host, int(port)
         finally:
-            writer.close()
+            channel.close()
 
     async def close(self) -> None:
         if self._control_task is not None:
             self._control_task.cancel()
-        if self._control_writer is not None:
-            with contextlib.suppress(Exception):
-                self._control_writer.close()
+        if self._control is not None:
+            self._control.close()

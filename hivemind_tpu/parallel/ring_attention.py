@@ -75,13 +75,17 @@ def _ring_flash_forward(q, k, v, axis_name: str, interpret: bool):
 
     axis_size = lax.psum(1, axis_name)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
-    out_acc = q * 0
-    # [B, H, T_local] lse carry, derived from q to inherit its manual axes
-    lse_acc = jnp.transpose(q[..., 0], (0, 2, 1)) * 0 + _NEG_INF
+    # accumulate in float32 regardless of the input dtype: the kernel's lse output
+    # is float32, and lax.scan requires carry dtypes to be identical across steps
+    # (bf16 inits would be promoted by the merge and fail tracing)
+    out_acc = (q * 0).astype(jnp.float32)
+    # [B, H, T_local] lse carry, derived from q to inherit its varying manual axes
+    lse_acc = (jnp.transpose(q[..., 0], (0, 2, 1)) * 0).astype(jnp.float32) + _NEG_INF
 
     def body(carry, _):
         k_cur, v_cur, out_acc, lse_acc = carry
         out_i, lse_i = flash_attention_lse(q, k_cur, v_cur, interpret=interpret)
+        out_i = out_i.astype(jnp.float32)
         new_lse = jnp.logaddexp(lse_acc, lse_i)
         w_old = jnp.exp(lse_acc - new_lse)
         w_new = jnp.exp(lse_i - new_lse)

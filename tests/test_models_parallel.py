@@ -96,6 +96,16 @@ def test_ring_flash_attention_matches_plain():
         result = jax.jit(ring)(q, k, v)
     assert np.allclose(np.asarray(result), np.asarray(expected), atol=1e-4)
 
+    # bf16 inputs (the flagship model's compute dtype) must trace and stay close:
+    # the scan carries are fp32 regardless of input dtype
+    q16, k16, v16 = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    with mesh:
+        result16 = jax.jit(ring)(q16, k16, v16)
+    assert result16.dtype == jnp.bfloat16
+    assert np.allclose(
+        np.asarray(result16, np.float32), np.asarray(expected), atol=0.05
+    )
+
     # gradients flow through the custom_vjp einsum-ring recompute
     def ring_loss(q, k, v):
         return jnp.sum(ring(q, k, v) ** 2)

@@ -88,7 +88,7 @@ async def test_relay_register_requires_key_proof(relay_process):
     import base64
 
     from hivemind_tpu.p2p.peer_id import PeerID
-    from hivemind_tpu.p2p.relay import _recv_frame, _send_frame, register_control
+    from hivemind_tpu.p2p.relay import RelayChannel, _recv_frame, _send_frame, register_control
     from hivemind_tpu.utils.crypto import Ed25519PrivateKey
 
     port = relay_process
@@ -105,7 +105,7 @@ async def test_relay_register_requires_key_proof(relay_process):
         pytest.skip("relay daemon running without libcrypto: legacy unauthenticated mode")
 
     r1, w1 = await _raw_conn(port)
-    assert await register_control(r1, w1, victim_id, victim) == b"O"
+    assert await register_control(RelayChannel(r1, w1), victim_id, victim) == b"O"
 
     # attacker presents the victim's (public) pubkey — hash matches — but can only
     # sign with its own key: the signature check must fail
@@ -134,10 +134,55 @@ async def test_relay_register_requires_key_proof(relay_process):
 
     # the owner reclaims: second registration with a valid proof evicts line 1
     r4, w4 = await _raw_conn(port)
-    assert await register_control(r4, w4, victim_id, victim) == b"O"
+    assert await register_control(RelayChannel(r4, w4), victim_id, victim) == b"O"
     assert await r1.read(100) == b""  # old control line was closed by the daemon
     w4.close()
     w1.close()
+
+
+async def test_relay_encrypted_control_channel(relay_process):
+    """The 'H' handshake gives an AEAD control channel bound to the relay's Ed25519
+    identity: registration and a full relayed RPC work through it, a wrong pinned
+    identity is refused before any control op, and TOFU pinning sticks."""
+    from hivemind_tpu.p2p.relay import open_relay_channel
+
+    port = relay_process
+    channel = await open_relay_channel("127.0.0.1", port)
+    if not channel.encrypted:
+        pytest.skip("relay daemon running without libcrypto: no encrypted channel")
+    relay_identity = channel.relay_pubkey
+    assert len(relay_identity) == 32
+    channel.close()
+
+    # pinning the wrong identity must refuse the channel outright
+    with pytest.raises(ConnectionError, match="identity mismatch"):
+        await open_relay_channel("127.0.0.1", port, relay_pubkey=b"\x42" * 32)
+
+    # end-to-end: server registers over the encrypted channel (pinned), client dials
+    server = await P2P.create()
+    client = await P2P.create()
+
+    async def negate(request: test_pb2.TestRequest, context: P2PContext) -> test_pb2.TestResponse:
+        return test_pb2.TestResponse(number=-request.number)
+
+    await server.add_protobuf_handler("negate", negate, test_pb2.TestRequest)
+    server_relay = await RelayClient.create(
+        server, "127.0.0.1", port, relay_pubkey=relay_identity
+    )
+    assert server_relay._control.encrypted
+
+    client_relay = RelayClient(client, "127.0.0.1", port)
+    await client_relay.dial(server.peer_id)
+    assert client_relay.relay_pubkey == relay_identity  # TOFU pinned from the dial
+
+    response = await client.call_protobuf_handler(
+        server.peer_id, "negate", test_pb2.TestRequest(number=7), test_pb2.TestResponse
+    )
+    assert response.number == -7
+
+    await server_relay.close()
+    await client.shutdown()
+    await server.shutdown()
 
 
 async def test_relay_reregister_different_id_no_stale_route(relay_process):
@@ -145,7 +190,7 @@ async def test_relay_reregister_different_id_no_stale_route(relay_process):
     old id: a later DIAL for the old id gets a clean refusal (regression: the stale
     g_control entry used to deref a dangling conn and crash the daemon)."""
     from hivemind_tpu.p2p.peer_id import PeerID
-    from hivemind_tpu.p2p.relay import _recv_frame, _send_frame, register_control
+    from hivemind_tpu.p2p.relay import RelayChannel, _recv_frame, _send_frame, register_control
     from hivemind_tpu.utils.crypto import Ed25519PrivateKey
 
     port = relay_process
@@ -154,8 +199,8 @@ async def test_relay_reregister_different_id_no_stale_route(relay_process):
     id_b = PeerID.from_private_key(key_b).to_bytes()
 
     r1, w1 = await _raw_conn(port)
-    assert await register_control(r1, w1, id_a, key_a) == b"O"
-    assert await register_control(r1, w1, id_b, key_b) == b"O"  # same line, new id
+    assert await register_control(RelayChannel(r1, w1), id_a, key_a) == b"O"
+    assert await register_control(RelayChannel(r1, w1), id_b, key_b) == b"O"  # same line, new id
 
     rd, wd = await _raw_conn(port)
     await _send_frame(wd, b"D" + os.urandom(16) + id_a)
@@ -180,7 +225,7 @@ async def test_relay_backpressure_bounds_memory(relay_process):
     drop) instead of buffering at line rate; memory stays bounded and every byte
     still arrives once the receiver drains (ADVICE r1: level-triggered EPOLLIN)."""
     from hivemind_tpu.p2p.peer_id import PeerID
-    from hivemind_tpu.p2p.relay import _recv_frame, _send_frame, register_control
+    from hivemind_tpu.p2p.relay import RelayChannel, _recv_frame, _send_frame, register_control
     from hivemind_tpu.utils.crypto import Ed25519PrivateKey
 
     port = relay_process
@@ -189,7 +234,7 @@ async def test_relay_backpressure_bounds_memory(relay_process):
     peer_id = PeerID.from_private_key(server_key).to_bytes()
 
     rs, ws = await _raw_conn(port)
-    assert await register_control(rs, ws, peer_id, server_key) == b"O"
+    assert await register_control(RelayChannel(rs, ws), peer_id, server_key) == b"O"
 
     rd, wd = await _raw_conn(port)
     token = os.urandom(16)
