@@ -35,7 +35,11 @@
 //             hole punching; role parity with libp2p identify/observed-addr)
 // After 'O' on a DIAL/ACCEPT pair the two sockets are spliced byte-for-byte.
 //
-// Build: g++ -O2 -std=c++17 -o relay_daemon relay_daemon.cpp   (see Makefile)
+// Usage: relay_daemon [port] [identity_file]
+//   identity_file (optional): raw 32-byte Ed25519 private key, loaded if present,
+//   created (0600) otherwise — keeps the relay identity stable across restarts so
+//   client pins keep working.
+// Build: g++ -O2 -std=c++17 -o relay_daemon relay_daemon.cpp -ldl  (see Makefile)
 
 #include <arpa/inet.h>
 #include <dlfcn.h>
@@ -101,6 +105,8 @@ typedef struct evp_md_st EVP_MD;
 static constexpr int EVP_PKEY_X25519 = 1034;  // NID_X25519
 static constexpr int CTRL_AEAD_GET_TAG = 0x10, CTRL_AEAD_SET_TAG = 0x11;
 
+static EVP_PKEY* (*new_raw_private_key)(int, void*, const unsigned char*, size_t) = nullptr;
+static int (*get_raw_private_key)(const EVP_PKEY*, unsigned char*, size_t*) = nullptr;
 static EVP_PKEY_CTX* (*pkey_ctx_new_id)(int, void*) = nullptr;
 static void (*pkey_ctx_free)(EVP_PKEY_CTX*) = nullptr;
 static int (*keygen_init)(EVP_PKEY_CTX*) = nullptr;
@@ -140,6 +146,8 @@ static bool load() {
   digest_verify = (decltype(digest_verify))dlsym(lib, "EVP_DigestVerify");
   sha256_fn = (decltype(sha256_fn))dlsym(lib, "SHA256");
 
+  new_raw_private_key = (decltype(new_raw_private_key))dlsym(lib, "EVP_PKEY_new_raw_private_key");
+  get_raw_private_key = (decltype(get_raw_private_key))dlsym(lib, "EVP_PKEY_get_raw_private_key");
   pkey_ctx_new_id = (decltype(pkey_ctx_new_id))dlsym(lib, "EVP_PKEY_CTX_new_id");
   pkey_ctx_free = (decltype(pkey_ctx_free))dlsym(lib, "EVP_PKEY_CTX_free");
   keygen_init = (decltype(keygen_init))dlsym(lib, "EVP_PKEY_keygen_init");
@@ -164,7 +172,8 @@ static bool load() {
   decrypt_final = (decltype(decrypt_final))dlsym(lib, "EVP_DecryptFinal_ex");
   cipher_ctx_ctrl = (decltype(cipher_ctx_ctrl))dlsym(lib, "EVP_CIPHER_CTX_ctrl");
 
-  channel_available = pkey_ctx_new_id && pkey_ctx_free && keygen_init && keygen &&
+  channel_available = new_raw_private_key && get_raw_private_key &&
+                      pkey_ctx_new_id && pkey_ctx_free && keygen_init && keygen &&
                       get_raw_public_key && digest_sign_init && digest_sign && derive_init &&
                       derive_set_peer && derive && pkey_ctx_new && hmac_fn && sha256_md &&
                       cipher_ctx_new && cipher_ctx_free && chacha20_poly1305 && encrypt_init &&
@@ -674,7 +683,33 @@ int main(int argc, char** argv) {
   if (!relay_crypto::available)
     fprintf(stderr, "relay: libcrypto unavailable, registrations are UNAUTHENTICATED\n");
   if (relay_crypto::channel_available) {
-    g_relay_identity = relay_crypto::generate_key(relay_crypto::EVP_PKEY_ED25519);
+    const char* identity_path = argc > 2 ? argv[2] : nullptr;
+    if (identity_path != nullptr) {
+      // persistent identity so client pins survive daemon restarts
+      FILE* f = fopen(identity_path, "rb");
+      if (f != nullptr) {
+        unsigned char raw[32];
+        if (fread(raw, 1, 32, f) == 32)
+          g_relay_identity = relay_crypto::new_raw_private_key(
+              relay_crypto::EVP_PKEY_ED25519, nullptr, raw, 32);
+        fclose(f);
+      }
+    }
+    if (g_relay_identity == nullptr) {
+      g_relay_identity = relay_crypto::generate_key(relay_crypto::EVP_PKEY_ED25519);
+      if (g_relay_identity != nullptr && identity_path != nullptr) {
+        unsigned char raw[32];
+        size_t raw_len = 32;
+        int fd = open(identity_path, O_WRONLY | O_CREAT | O_TRUNC, 0600);
+        if (fd >= 0 &&
+            relay_crypto::get_raw_private_key(g_relay_identity, raw, &raw_len) == 1 &&
+            raw_len == 32) {
+          if (write(fd, raw, 32) != 32)
+            fprintf(stderr, "relay: could not persist identity to %s\n", identity_path);
+        }
+        if (fd >= 0) close(fd);
+      }
+    }
     if (g_relay_identity != nullptr && !relay_crypto::raw_public(g_relay_identity, g_relay_pub)) {
       g_relay_identity = nullptr;
       fprintf(stderr, "relay: identity keygen failed, encrypted control disabled\n");

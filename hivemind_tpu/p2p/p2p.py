@@ -110,7 +110,14 @@ class P2P:
         announce_host: Optional[str] = None,
         initial_peers: Sequence[Union[str, Multiaddr]] = (),
         dial_timeout: float = 10.0,
+        relays: Sequence[str] = (),
     ) -> "P2P":
+        """``relays``: relay daemons to register at on startup (reference parity:
+        p2p_daemon.py use_relay/use_auto_relay). Each spec is ``host:port`` or
+        ``<relay_pubkey_hex>@host:port`` — the pinned form refuses a relay that
+        cannot prove the expected Ed25519 identity over the encrypted control
+        channel. Registration makes this peer dialable through the relay; failures
+        are non-fatal (logged), matching initial_peers semantics."""
         self = object.__new__(cls)
         self._identity_lock_fd: Optional[int] = None
         if identity is None:
@@ -128,6 +135,7 @@ class P2P:
         self._dial_timeout = dial_timeout
         self._bg_tasks: Set[asyncio.Task] = set()  # strong refs: loop holds tasks weakly
         self._alive_refs = 1  # P2P.replicate parity: shared instance refcount
+        self._relays: list = []  # RelayClients registered via the `relays` kwarg
         self._listen_host = listen_host
         self._announce_host = announce_host or listen_host
 
@@ -145,11 +153,30 @@ class P2P:
                     await self.connect(maddr)
                 except Exception as e:
                     logger.warning(f"could not reach initial peer {maddr}: {e}")
+
+            for relay_spec in relays:
+                from hivemind_tpu.p2p.relay import RelayClient
+
+                pubkey, _, hostport = relay_spec.rpartition("@")
+                relay_host, _, relay_port = hostport.rpartition(":")
+                try:
+                    self._relays.append(
+                        await RelayClient.create(
+                            self, relay_host, int(relay_port), relay_pubkey=pubkey or None
+                        )
+                    )
+                except Exception as e:
+                    logger.warning(f"could not register at relay {relay_spec}: {e}")
         except BaseException:
             # any failure mid-create must not leak the listener, peer connections
             # already established, or the identity flock ("taken") for the process
             if self._server is not None:
                 self._server.close()
+            for relay in self._relays:
+                try:
+                    await asyncio.shield(relay.close())
+                except BaseException:
+                    pass
             for conn in list(self._all_connections):
                 try:
                     await asyncio.shield(conn.close())
@@ -495,6 +522,9 @@ class P2P:
         if self._alive_refs > 0:
             return
         self._server.close()
+        for relay in self._relays:
+            await relay.close()
+        self._relays.clear()
         for task in list(self._bg_tasks):
             task.cancel()
         for conn in list(self._all_connections):

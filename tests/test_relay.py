@@ -185,6 +185,52 @@ async def test_relay_encrypted_control_channel(relay_process):
     await server.shutdown()
 
 
+async def test_p2p_create_relays_kwarg(relay_process):
+    """P2P.create(relays=[...]) registers at the relay on startup (reference parity:
+    use_relay/use_auto_relay) — a peer started this way is dialable through the
+    relay with no direct address exchange."""
+    port = relay_process
+    server = await P2P.create(relays=[f"127.0.0.1:{port}"])
+    assert len(server._relays) == 1
+    client = await P2P.create()
+
+    async def half(request: test_pb2.TestRequest, context: P2PContext) -> test_pb2.TestResponse:
+        return test_pb2.TestResponse(number=request.number // 2)
+
+    await server.add_protobuf_handler("half", half, test_pb2.TestRequest)
+    await RelayClient(client, "127.0.0.1", port).dial(server.peer_id)
+    response = await client.call_protobuf_handler(
+        server.peer_id, "half", test_pb2.TestRequest(number=84), test_pb2.TestResponse
+    )
+    assert response.number == 42
+    await client.shutdown()
+    await server.shutdown()
+
+
+def test_relay_identity_persists_across_restarts(tmp_path):
+    """With an identity file, the daemon announces the SAME Ed25519 identity after a
+    restart, so client pins keep working."""
+    identity_file = tmp_path / "relay.key"
+
+    def start_and_read_identity():
+        proc = subprocess.Popen(
+            [str(RELAY_BIN), "0", str(identity_file)], stdout=subprocess.PIPE, text=True
+        )
+        try:
+            proc.stdout.readline()  # listening line
+            line = proc.stdout.readline().strip()
+        finally:
+            proc.kill()
+            proc.wait()
+        if not line.startswith("relay identity "):
+            pytest.skip("relay daemon running without libcrypto: no identity")
+        return line.rsplit(" ", 1)[-1]
+
+    first = start_and_read_identity()
+    assert identity_file.exists() and len(identity_file.read_bytes()) == 32
+    assert start_and_read_identity() == first
+
+
 async def test_relay_reregister_different_id_no_stale_route(relay_process):
     """One control line re-registering under a NEW peer_id must drop the route to its
     old id: a later DIAL for the old id gets a clean refusal (regression: the stale
