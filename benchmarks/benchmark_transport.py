@@ -21,6 +21,19 @@ async def run(args):
     from hivemind_tpu.proto import runtime_pb2
     from hivemind_tpu.compression import serialize_tensor, split_tensor_for_streaming
 
+    relay_proc = None
+    if args.relay:
+        # route the stream through the native relay daemon (splice data path)
+        import subprocess
+
+        native = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                              "hivemind_tpu", "native")
+        subprocess.run(["make"], cwd=native, check=True, capture_output=True)
+        relay_proc = subprocess.Popen(
+            [os.path.join(native, "relay_daemon"), "0"], stdout=subprocess.PIPE, text=True
+        )
+        relay_port = int(relay_proc.stdout.readline().strip().rsplit(" ", 1)[-1])
+
     server = await P2P.create()
     client = await P2P.create()
     received = []
@@ -36,7 +49,13 @@ async def run(args):
     await server.add_protobuf_handler(
         "sink", sink, runtime_pb2.ExpertRequest, stream_input=True, stream_output=True
     )
-    await client.connect(server.get_visible_maddrs()[0])
+    if args.relay:
+        from hivemind_tpu.p2p.relay import RelayClient
+
+        await RelayClient.create(server, "127.0.0.1", relay_port)
+        await RelayClient(client, "127.0.0.1", relay_port).dial(server.peer_id)
+    else:
+        await client.connect(server.get_visible_maddrs()[0])
 
     payload = np.random.RandomState(0).randn(args.mbytes * 1024 * 1024 // 4).astype(np.float32)
     serialized = serialize_tensor(payload)
@@ -59,16 +78,22 @@ async def run(args):
         "unit": "MB/s",
         "extra": {
             "payload_mb": round(mb, 1), "seconds": round(elapsed, 3),
-            "path": "tcp + noise AEAD + mux, localhost",
+            "path": ("relay splice + noise AEAD + mux, localhost" if args.relay
+                     else "tcp + noise AEAD + mux, localhost"),
         },
     }))
     await client.shutdown()
     await server.shutdown()
+    if relay_proc is not None:
+        relay_proc.kill()
+        relay_proc.wait()
 
 
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--mbytes", type=int, default=256)
+    parser.add_argument("--relay", action="store_true",
+                        help="route through the native relay daemon (circuit splice)")
     args = parser.parse_args()
     asyncio.run(run(args))
 
