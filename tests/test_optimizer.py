@@ -184,6 +184,107 @@ def test_optimizer_collaborative_convergence():
             dht.shutdown()
 
 
+def test_optimizer_client_mode_peer_contributes():
+    """A client_mode peer (firewalled: sends gradients, never reduces) trains
+    alongside two full peers; all three stay epoch-synced and converge, and the
+    client's samples count toward the global batch (reference optimizer.py
+    client_mode semantics)."""
+    rng = np.random.RandomState(1)
+    true_w = rng.randn(8).astype(np.float32)
+    features = rng.randn(256, 8).astype(np.float32)
+    targets = features @ true_w
+
+    @jax.jit
+    def loss_and_grad(params, x, y):
+        def loss_fn(p):
+            return jnp.mean((x @ p["w"] - y) ** 2)
+
+        return jax.value_and_grad(loss_fn)(params)
+
+    dhts = launch_dht_swarm(3)
+    results = {}
+    errors = []
+
+    def run_peer(index: int, dht: DHT, client_mode: bool):
+        try:
+            opt = Optimizer(
+                dht=dht, run_id="client_mode_test", target_batch_size=96,
+                params={"w": jnp.zeros(8, jnp.float32)}, optimizer=optax.sgd(0.3),
+                batch_size_per_step=16, matchmaking_time=1.5, averaging_timeout=30,
+                average_state_every=1, target_group_size=2, client_mode=client_mode,
+                verbose=False,
+                tracker_opts=dict(min_refresh_period=0.3, default_refresh_period=0.5),
+            )
+            rng_local = np.random.RandomState(index)
+            first_loss = last_loss = None
+            for _ in range(60):
+                if opt.local_epoch >= 4:
+                    break
+                idx = rng_local.choice(len(features), 16)
+                loss, grads = loss_and_grad(opt.params, features[idx], targets[idx])
+                first_loss = first_loss if first_loss is not None else float(loss)
+                last_loss = float(loss)
+                opt.step(grads)
+                time.sleep(0.25)
+            results[index] = (first_loss, last_loss, opt.local_epoch, client_mode)
+            opt.shutdown()
+        except Exception as e:
+            import traceback
+
+            errors.append((index, e, traceback.format_exc()))
+
+    threads = [
+        threading.Thread(target=run_peer, args=(i, dht, i == 2))
+        for i, dht in enumerate(dhts)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=240)
+    try:
+        assert not errors, f"peer failures: {errors}"
+        assert len(results) == 3
+        for index, (first_loss, last_loss, epoch, client_mode) in results.items():
+            role = "client" if client_mode else "node"
+            assert epoch >= 2, f"{role} peer {index} stuck at epoch {epoch}"
+            assert last_loss < first_loss / 5, (
+                f"{role} peer {index}: loss {first_loss:.4f} -> {last_loss:.4f}"
+            )
+    finally:
+        for dht in dhts:
+            dht.shutdown()
+
+
+def test_averager_rejects_mismatched_schema():
+    """Averaging only makes sense over identical tensor schemas: peers whose tensor
+    shapes differ must never form a group (the reference guards this with a schema
+    hash checked at rpc_join_group — averager.py:812-821)."""
+    from hivemind_tpu.averaging import DecentralizedAverager
+
+    dhts = launch_dht_swarm(2)
+    try:
+        avg_a = DecentralizedAverager(
+            [np.zeros((4, 4), np.float32)], dhts[0], prefix="schema_guard",
+            start=True, min_matchmaking_time=1.0, request_timeout=1.0,
+        )
+        avg_b = DecentralizedAverager(
+            [np.zeros((8,), np.float32)], dhts[1], prefix="schema_guard",
+            start=True, min_matchmaking_time=1.0, request_timeout=1.0,
+        )
+        assert avg_a.schema_hash != avg_b.schema_hash
+        # both step concurrently under the same prefix; neither may accept the other
+        control_b = avg_b.step(wait=False, timeout=6.0, allow_retries=False)
+        with pytest.raises(Exception):
+            avg_a.step(timeout=6.0, allow_retries=False)
+        with pytest.raises(Exception):
+            control_b.result(timeout=15)
+        avg_a.shutdown()
+        avg_b.shutdown()
+    finally:
+        for dht in dhts:
+            dht.shutdown()
+
+
 def test_single_peer_epoch_progress():
     """A LONE peer's own report completes the epoch: readiness must arrive within
     ~a second, not after max_refresh_period (regression: the fetcher slept out its
