@@ -125,6 +125,12 @@ def main():
                 f"{args.target_batch_size} samples)"
             )
 
+    # reached max_steps (benchmarks/smoke runs): leave the swarm cleanly so the
+    # process actually exits instead of hanging on background threads
+    logger.info(f"training finished after {step} steps at epoch {opt.local_epoch}, final loss {loss_ema:.4f}")
+    opt.shutdown()
+    dht.shutdown()
+
 
 if __name__ == "__main__":
     main()

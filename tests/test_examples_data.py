@@ -1,8 +1,12 @@
 """examples/albert data pipeline: self-contained corpus tokenizer + BERT-style
-masking statistics, and the sampler fallback chain."""
+masking statistics, and the sampler fallback chain; 2-peer smoke run of the
+actual run_trainer.py recipe."""
 
 import os
+import re
+import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -78,3 +82,49 @@ def test_shared_vocab_across_peers(tmp_path, corpus):
         from hivemind_tpu.models import AlbertConfig
 
         make_batch_sampler(AlbertConfig.tiny(max_position=16), 16, hf_tokenizer="bert-base-uncased")
+
+
+def test_run_trainer_two_peer_smoke():
+    """The flagship recipe end-to-end: two run_trainer.py processes (tiny config,
+    synthetic data) form a swarm, advance epochs together, and exit cleanly after
+    max_steps (regression: the trainer used to hang on background threads)."""
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    script = os.path.join(repo, "examples", "albert", "run_trainer.py")
+    common = [
+        sys.executable, script, "--tiny", "--platform", "cpu",
+        "--run_id", "smoke", "--max_steps", "16", "--target_batch_size", "64",
+        "--batch_size", "16", "--seq_len", "64", "--matchmaking_time", "1.0",
+    ]
+    env = {**os.environ, "PYTHONPATH": repo}
+    first = subprocess.Popen(
+        common + ["--seed", "0"], stderr=subprocess.PIPE, text=True, cwd=repo, env=env
+    )
+    try:
+        maddr = None
+        deadline = time.monotonic() + 120
+        lines = []
+        while time.monotonic() < deadline:
+            line = first.stderr.readline()
+            lines.append(line)
+            found = re.search(r"--initial_peers (\S+)", line)
+            if found:
+                maddr = found.group(1)
+                break
+        assert maddr, f"first peer never announced its address: {''.join(lines)[-2000:]}"
+
+        second = subprocess.run(
+            common + ["--seed", "1", "--initial_peers", maddr],
+            stderr=subprocess.PIPE, text=True, cwd=repo, timeout=240, env=env,
+        )
+        first_err = first.communicate(timeout=120)[1]
+        logs = "".join(lines) + (first_err or "") + (second.stderr or "")
+        assert second.returncode == 0, logs[-3000:]
+        assert first.returncode == 0, logs[-3000:]
+        finished = re.findall(r"training finished after 16 steps at epoch (\d+)", logs)
+        assert len(finished) == 2, logs[-3000:]
+        # 2 peers x 16 steps x 16 samples = 512 samples = 8 virtual epochs of 64:
+        # both peers must have transitioned epochs collaboratively at least twice
+        assert all(int(epoch) >= 2 for epoch in finished), finished
+    finally:
+        if first.poll() is None:
+            first.kill()
