@@ -13,6 +13,8 @@ def main():
     parser.add_argument("--run_id", default="albert_demo")
     parser.add_argument("--initial_peers", nargs="*", required=True)
     parser.add_argument("--refresh_period", type=float, default=5.0)
+    parser.add_argument("--max_reports", type=int, default=0,
+                        help="exit after this many progress reports (0 = run forever)")
     args = parser.parse_args()
 
     import jax
@@ -35,6 +37,7 @@ def main():
     )
     progress_key = f"{args.run_id}_progress"
 
+    reports = 0
     while True:
         time.sleep(args.refresh_period)
         result = dht.get(progress_key, latest=True)
@@ -56,6 +59,11 @@ def main():
             f"epoch {epoch}: {len(records)} peers, {samples} samples accumulated, "
             f"{sps:.0f} samples/s aggregate"
         )
+        reports += 1
+        if args.max_reports and reports >= args.max_reports:
+            break
+
+    dht.shutdown()
 
 
 if __name__ == "__main__":

@@ -112,6 +112,14 @@ def test_run_trainer_two_peer_smoke():
                 break
         assert maddr, f"first peer never announced its address: {''.join(lines)[-2000:]}"
 
+        # the monitor joins as a non-training observer and must see swarm progress
+        monitor_script = os.path.join(repo, "examples", "albert", "run_training_monitor.py")
+        monitor = subprocess.Popen(
+            [sys.executable, monitor_script, "--run_id", "smoke", "--initial_peers",
+             maddr, "--refresh_period", "2.0", "--max_reports", "1"],
+            stderr=subprocess.PIPE, text=True, cwd=repo, env=env,
+        )
+
         second = subprocess.run(
             common + ["--seed", "1", "--initial_peers", maddr],
             stderr=subprocess.PIPE, text=True, cwd=repo, timeout=240, env=env,
@@ -125,6 +133,14 @@ def test_run_trainer_two_peer_smoke():
         # 2 peers x 16 steps x 16 samples = 512 samples = 8 virtual epochs of 64:
         # both peers must have transitioned epochs collaboratively at least twice
         assert all(int(epoch) >= 2 for epoch in finished), finished
+
+        monitor_err = monitor.communicate(timeout=60)[1]
+        assert monitor.returncode == 0, monitor_err[-2000:]
+        assert re.search(r"epoch \d+: \d+ peers, \d+ samples accumulated", monitor_err), (
+            monitor_err[-2000:]
+        )
     finally:
         if first.poll() is None:
             first.kill()
+        if "monitor" in locals() and monitor.poll() is None:
+            monitor.kill()
