@@ -25,6 +25,11 @@ def main():
     parser.add_argument("--expert_cls", default="ffn",
                         help="registered expert class; input shape comes from its "
                              "registry schema (block classes take [batch, seq, hid])")
+    parser.add_argument("--decode_clients", type=int, default=0,
+                        help=">0: measure KV-session decoding — this many concurrent "
+                             "1-token streams through one block (continuous batching)")
+    parser.add_argument("--decode_steps", type=int, default=64,
+                        help="tokens per decode client")
     from hivemind_tpu.utils.platform import add_platform_arg, apply_platform
 
     add_platform_arg(parser)
@@ -58,6 +63,66 @@ def main():
     infos = get_experts(client_dht, uids)
     assert all(info is not None for info in infos), "experts not discoverable"
     experts = [RemoteExpert(info, client_dht.node.p2p) for info in infos]
+
+    if args.decode_clients:
+        # continuous-batching decode: N clients each own a KV session on ONE block
+        # and step one token at a time; concurrent steps merge into vmapped device
+        # calls server-side (A/B with HIVEMIND_TPU_DECODE_BATCHING=0)
+        import uuid
+
+        block = experts[0]
+        prompt, hid = 8, args.hidden_dim
+        sessions = [uuid.uuid4().hex for _ in range(args.decode_clients)]
+        rng = np.random.RandomState(0)
+        prompts = rng.randn(args.decode_clients, 1, prompt, hid).astype(np.float32)
+        for session, chunk in zip(sessions, prompts):
+            block.decode_np(chunk, session, reset=True)
+        token = rng.randn(1, 1, hid).astype(np.float32)
+        done = [0] * args.decode_clients
+        errors = []
+
+        # untimed warmup round: trigger the batched-step compiles (pow2 buckets)
+        # so short measured runs aren't dominated by jit time
+        warmup = [threading.Thread(target=block.decode_np, args=(token, s, False))
+                  for s in sessions]
+        for t in warmup:
+            t.start()
+        for t in warmup:
+            t.join()
+
+        def decode_loop(index: int):
+            try:
+                for _ in range(args.decode_steps):
+                    block.decode_np(token, sessions[index], reset=False)
+                    done[index] += 1
+            except Exception as e:
+                errors.append((index, repr(e)))
+
+        start = time.perf_counter()
+        threads = [threading.Thread(target=decode_loop, args=(i,))
+                   for i in range(args.decode_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - start
+        manager = server.handler.decode_sessions
+        print(json.dumps({
+            "metric": "moe_decode_tokens_per_sec_aggregate",
+            "value": round(sum(done) / elapsed, 1),
+            "unit": "tokens/s",
+            "extra": {
+                "decode_clients": args.decode_clients, "steps_per_client": args.decode_steps,
+                "hidden_dim": args.hidden_dim, "expert_cls": args.expert_cls,
+                "batching": manager.batching_enabled,
+                "batched_signatures": sorted(s for _, s in manager._batched_fns),
+                "errors": errors[:3],
+            },
+        }))
+        client_dht.shutdown()
+        server.shutdown()
+        server.dht.shutdown()
+        return
 
     processed = [0] * args.num_clients
     errors = []

@@ -89,16 +89,15 @@ class ConnectionHandler(ServicerBase):
         return runtime_pb2.ExpertResponse(tensors=[serialize_tensor(g) for g in grads])
 
     async def _run_decode(self, uid: str, metadata: bytes, tensors: List[np.ndarray]) -> np.ndarray:
-        import asyncio
-
         meta = MSGPackSerializer.loads(metadata) if metadata else {}
         session_id = meta.get("session_id")
         if not session_id:
             raise ValueError("rpc_decode requires a session_id in request metadata")
         [x] = tensors
-        return await asyncio.get_running_loop().run_in_executor(
-            None, self.decode_sessions.decode, uid, str(session_id), x,
-            bool(meta.get("reset", False)),
+        # decode_async merges concurrent single-token steps from different client
+        # sessions into one vmapped device call (continuous batching)
+        return await self.decode_sessions.decode_async(
+            uid, str(session_id), x, bool(meta.get("reset", False))
         )
 
     async def rpc_decode(self, request: runtime_pb2.ExpertRequest, context: P2PContext) -> runtime_pb2.ExpertResponse:

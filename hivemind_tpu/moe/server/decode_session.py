@@ -11,6 +11,15 @@ token. Caches live on-device in the block's compact kv-heads layout
 (uid, batch, chunk-length) signature, and sessions expire by TTL / LRU cap so an
 abandoned client cannot pin device memory.
 
+**Continuous batching** (`decode_async`): single-token steps from different
+clients' sessions that arrive within a small window are merged into ONE device
+call — the per-session step is `jax.vmap`-ed over a stacked session axis (params
+broadcast; each row carries its own cache and per-row write index), with the
+session count bucketed to powers of two so the jit cache stays small. One
+dispatch serves every concurrent stream, which is what keeps a serving chip busy
+when many clients decode one token at a time. Disable with
+``HIVEMIND_TPU_DECODE_BATCHING=0`` for A/B runs.
+
 No reference equivalent (the reference serves stateless experts; Petals is its
 downstream project — README.md:35-40). Fault note: decode sessions are sticky to
 the serving peer — if it dies, the client must re-prefill on a replacement
@@ -19,9 +28,11 @@ cache)."""
 
 from __future__ import annotations
 
+import asyncio
+import os
 import threading
 import time
-from typing import Dict, Tuple
+from typing import Dict, List, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -58,12 +69,18 @@ class DecodeSessionManager:
     """
 
     def __init__(self, backends, max_len: int = 256, session_ttl: float = 600.0,
-                 max_sessions: int = 64):
+                 max_sessions: int = 64, flush_window: float = 0.002):
         self.backends = backends
         self.max_len, self.session_ttl, self.max_sessions = max_len, session_ttl, max_sessions
+        self.flush_window = flush_window  # how long a drainer waits for stragglers
         self._sessions: Dict[Tuple[str, str], _Session] = {}
         self._step_fns: Dict[Tuple[str, int, int], callable] = {}
+        self._batched_fns: Dict[Tuple[str, int], callable] = {}
+        self._dummy_caches: Dict[str, tuple] = {}  # per-uid padding rows for pow2 buckets
         self._lock = threading.Lock()
+        self._pending: Dict[str, List] = {}  # uid -> [(future, session, x), ...]
+        self._drainers: Dict[str, asyncio.Task] = {}
+        self.batching_enabled = os.environ.get("HIVEMIND_TPU_DECODE_BATCHING", "1") != "0"
 
     def supports(self, uid: str) -> bool:
         backend = self.backends.get(uid)
@@ -78,16 +95,21 @@ class DecodeSessionManager:
             oldest = min(self._sessions, key=lambda k: self._sessions[k].last_used)
             del self._sessions[oldest]
 
+    def _raw_step(self, uid: str):
+        """The un-jitted per-session step; shared by the direct and batched paths so
+        a signature change cannot silently diverge them."""
+        module = self.backends[uid].module
+
+        def step(params, x, cache_k, cache_v, index):
+            return module.apply({"params": params}, x, cache_k, cache_v, index)
+
+        return step
+
     def _step_fn(self, uid: str, batch: int, new_len: int):
         key = (uid, batch, new_len)
         fn = self._step_fns.get(key)
         if fn is None:
-            module = self.backends[uid].module
-
-            def step(params, x, cache_k, cache_v, index):
-                return module.apply({"params": params}, x, cache_k, cache_v, index)
-
-            fn = self._step_fns[key] = jax.jit(step, donate_argnums=(2, 3))
+            fn = self._step_fns[key] = jax.jit(self._raw_step(uid), donate_argnums=(2, 3))
         return fn
 
     def decode(self, uid: str, session_id: str, x: np.ndarray, reset: bool) -> np.ndarray:
@@ -152,3 +174,147 @@ class DecodeSessionManager:
             )
             session.index += new_len
             return np.asarray(y)[:, :new_len]
+
+    # ---- continuous batching of single-token steps across sessions ------------
+
+    async def decode_async(self, uid: str, session_id: str, x: np.ndarray, reset: bool):
+        """Asyncio entrypoint: batchable steps (continuation, chunk 1, session
+        batch 1) are merged with other clients' concurrent steps into one vmapped
+        device call; everything else takes the direct per-session path."""
+        loop = asyncio.get_running_loop()
+        x = np.asarray(x, np.float32)
+        batchable = (
+            self.batching_enabled and not reset
+            and x.ndim == 3 and x.shape[0] == 1 and x.shape[1] == 1
+        )
+        if not batchable:
+            return await loop.run_in_executor(None, self.decode, uid, session_id, x, reset)
+
+        with self._lock:
+            self._evict_locked()  # the direct path evicts in decode(); mirror it here
+            session = self._sessions.get((uid, session_id))
+        if session is None:
+            raise KeyError(
+                f"unknown or expired decode session {session_id!r} for {uid!r}; "
+                f"restart generation with reset=True"
+            )
+        future = loop.create_future()
+        with self._lock:
+            self._pending.setdefault(uid, []).append((future, session, x))
+            if uid not in self._drainers or self._drainers[uid].done():
+                self._drainers[uid] = loop.create_task(self._drain(uid))
+        return await future
+
+    async def _drain(self, uid: str) -> None:
+        loop = asyncio.get_running_loop()
+        await asyncio.sleep(self.flush_window)  # let concurrent streams pile up
+        with self._lock:
+            entries = self._pending.pop(uid, [])
+        if not entries:
+            return
+        # one session must not appear twice in a batch (its cache would fork):
+        # later duplicates roll over to the next drain round
+        seen, batch_entries, rollover = set(), [], []
+        for entry in entries:
+            if id(entry[1]) in seen:
+                rollover.append(entry)
+            else:
+                seen.add(id(entry[1]))
+                batch_entries.append(entry)
+        try:
+            results = await loop.run_in_executor(None, self._decode_batch, uid, batch_entries)
+            for (future, _session, _x), result in zip(batch_entries, results):
+                if not future.done():
+                    if isinstance(result, Exception):
+                        future.set_exception(result)
+                    else:
+                        future.set_result(result)
+        except Exception as e:
+            for future, _session, _x in batch_entries:
+                if not future.done():
+                    future.set_exception(e)
+        # steps that arrived WHILE the batch was computing (decode_async saw a live
+        # drainer and only enqueued) — and any same-session rollover — need a fresh
+        # drainer now, or they would strand until some future call happens to spawn one
+        with self._lock:
+            if rollover:
+                self._pending.setdefault(uid, []).extend(rollover)
+            if self._pending.get(uid):
+                self._drainers[uid] = loop.create_task(self._drain(uid))
+
+    def _batched_fn(self, uid: str, stack: int):
+        key = (uid, stack)
+        fn = self._batched_fns.get(key)
+        if fn is None:
+            fn = self._batched_fns[key] = jax.jit(
+                jax.vmap(self._raw_step(uid), in_axes=(None, 0, 0, 0, 0)),
+                donate_argnums=(2, 3),
+            )
+        return fn
+
+    def _dummy_rows(self, uid: str):
+        """A throwaway (cache_k, cache_v) pair used to pad batches to the bucket
+        size; its outputs and cache writes are discarded."""
+        pair = self._dummy_caches.get(uid)
+        if pair is None:
+            pair = self._dummy_caches[uid] = self.backends[uid].module.init_decode_cache(
+                1, self.max_len
+            )
+        return pair
+
+    def _decode_batch(self, uid: str, entries: List) -> List:
+        """Run one vmapped step over `entries` [(future, session, x)]; returns one
+        result (ndarray or Exception) per entry, in order."""
+        backend = self.backends[uid]
+        # per-session locks in a fixed order so the direct path cannot deadlock us
+        ordered = sorted(range(len(entries)), key=lambda i: id(entries[i][1]))
+        for i in ordered:
+            entries[i][1].lock.acquire()
+        try:
+            results: List = [None] * len(entries)
+            live = []
+            for i, (_future, session, x) in enumerate(entries):
+                if session.index == 0:
+                    results[i] = KeyError(f"decode session for {uid!r} has no prefill yet")
+                elif session.index + 1 > self.max_len:
+                    results[i] = ValueError(f"decode session is full ({session.index}/{self.max_len})")
+                elif session.cache_k.shape[0] != 1:
+                    results[i] = ValueError("batched decode requires session batch 1")
+                else:
+                    live.append(i)
+            if not live:
+                return results
+            stack = _next_pow2(len(live))
+            dummy_k, dummy_v = self._dummy_rows(uid)
+            xs, cks, cvs, idxs = [], [], [], []
+            for i in live:
+                _future, session, x = entries[i]
+                xs.append(jnp.asarray(x))
+                cks.append(session.cache_k)
+                cvs.append(session.cache_v)
+                idxs.append(session.index)
+            for _ in range(stack - len(live)):
+                xs.append(jnp.zeros_like(xs[0]))
+                cks.append(dummy_k)
+                cvs.append(dummy_v)
+                idxs.append(1)  # a valid mid-cache position; output is discarded
+            step = self._batched_fn(uid, stack)
+            y, new_k, new_v = step(
+                backend.snapshot_params(), jnp.stack(xs), jnp.stack(cks), jnp.stack(cvs),
+                jnp.asarray(idxs, jnp.int32),
+            )
+            y = np.asarray(y)
+            now = time.monotonic()
+            for row, i in enumerate(live):
+                _future, session, _x = entries[i]
+                session.cache_k = new_k[row]
+                session.cache_v = new_v[row]
+                session.index += 1
+                session.last_used = now
+                results[i] = y[row]
+            # (the dummy rows survive: donation frees the STACKED buffer, not the
+            # per-session/dummy constituents that were copied into it)
+            return results
+        finally:
+            for i in ordered:
+                entries[i][1].lock.release()
