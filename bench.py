@@ -244,11 +244,16 @@ def main() -> None:
     result = None
     if _tpu_reachable():
         for _attempt in range(2):
-            result = _measure_in_subprocess()
-            if result is not None and not result.get("tpu_unavailable"):
-                break
-    if result is None or result.get("tpu_unavailable"):
-        # honest CPU fallback, run inline (CPU jax cannot hang)
+            candidate = _measure_in_subprocess()
+            if candidate is not None:
+                # keep a completed result even when it is the tpu_unavailable CPU
+                # fallback (it is already honest and complete); retry once in case
+                # the TPU grab was transient, but never discard finished work
+                result = candidate
+                if not candidate.get("tpu_unavailable"):
+                    break
+    if result is None:
+        # child hung or crashed: run the CPU fallback inline (CPU jax cannot hang)
         result = measure_main(force_cpu=True)
 
     averaging = _averaging_gbps()

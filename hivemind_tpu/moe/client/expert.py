@@ -114,14 +114,25 @@ class RemoteExpert:
     def forward_np(self, *xs: np.ndarray) -> List[np.ndarray]:
         return RemoteExpertWorker.run_coroutine(self._call("forward", list(xs)))
 
-    def decode_np(self, x: np.ndarray, session_id: str, reset: bool = False) -> np.ndarray:
+    def decode_np(
+        self, x: np.ndarray, session_id: str, reset: bool = False, span: Optional[list] = None
+    ) -> np.ndarray:
         """One KV-cache decode-session step on the serving peer (rpc_decode):
         the prefill call (``reset=True``) seeds the session with the prompt chunk,
         later calls advance one token each — O(context) per token instead of the
         right-padded O(context²) recompute. Sessions are sticky to the peer; a
         continuation on an evicted session raises (restart with ``reset=True``).
-        Prefill chunks over the unary cap use the streaming decode RPC."""
-        metadata = MSGPackSerializer.dumps({"session_id": session_id, "reset": reset})
+        Prefill chunks over the unary cap use the streaming decode RPC.
+
+        :param span: uids of CONSECUTIVE pipeline blocks co-located on this peer
+            (first must be this expert's uid): the server chains their session
+            steps in one RPC, so a pipeline's per-token round-trips drop from
+            #blocks to #servers (Petals serves block spans the same way)."""
+        meta = {"session_id": session_id, "reset": reset}
+        if span is not None:
+            assert span[0] == self.uid, (span, self.uid)
+            meta["uids"] = list(span)
+        metadata = MSGPackSerializer.dumps(meta)
         [output] = RemoteExpertWorker.run_coroutine(self._call("decode", [x], metadata))
         return output
 

@@ -153,11 +153,19 @@ class RemoteSequential:
         if reset:
             # pin the route with FRESH immutable handles: _ResilientBlock objects
             # are shared and re-pointed in place by the periodic re-resolution, so
-            # pinning them would let the route silently move to a cache-less peer
-            pinned = [
+            # pinning them would let the route silently move to a cache-less peer.
+            # Consecutive blocks on the SAME peer form a span served by one RPC
+            # (Petals-style span execution): per-token round-trips = #servers.
+            blocks = [
                 RemoteExpert(self._resolve_info(index), self.p2p)
                 for index in range(self.num_blocks)
             ]
+            pinned = []  # [(first_block, [uid, uid, ...]), ...]
+            for block in blocks:
+                if pinned and pinned[-1][0].peer_id == block.peer_id:
+                    pinned[-1][1].append(block.uid)
+                else:
+                    pinned.append((block, [block.uid]))
             with self._lock:
                 self._decode_routes[session_id] = pinned
                 while len(self._decode_routes) > self.max_decode_routes:
@@ -170,10 +178,10 @@ class RemoteSequential:
                     f"decode session {session_id!r} has no pinned route here; "
                     f"start it with reset=True"
                 )
-        for block in pinned:
+        for block, span in pinned:
             # plain RemoteExpert: no retry/re-resolution — a replacement peer
             # would not hold this session's cache
-            x = block.decode_np(x, session_id, reset=reset)
+            x = block.decode_np(x, session_id, reset=reset, span=span)
         return x
 
     def close_decode_session(self, session_id: str) -> None:

@@ -94,11 +94,17 @@ class ConnectionHandler(ServicerBase):
         if not session_id:
             raise ValueError("rpc_decode requires a session_id in request metadata")
         [x] = tensors
-        # decode_async merges concurrent single-token steps from different client
-        # sessions into one vmapped device call (continuous batching)
-        return await self.decode_sessions.decode_async(
-            uid, str(session_id), x, bool(meta.get("reset", False))
-        )
+        # span execution: chain consecutive co-located pipeline blocks' session
+        # steps in ONE rpc (uids[0] must be the request uid); each per-uid step
+        # still goes through decode_async, so cross-client continuous batching
+        # applies at every block of the span
+        uids = meta.get("uids") or [uid]
+        if uids[0] != uid:
+            raise ValueError(f"span uids must start with the request uid {uid!r}, got {uids!r}")
+        reset = bool(meta.get("reset", False))
+        for span_uid in uids:
+            x = await self.decode_sessions.decode_async(span_uid, str(session_id), x, reset)
+        return x
 
     async def rpc_decode(self, request: runtime_pb2.ExpertRequest, context: P2PContext) -> runtime_pb2.ExpertResponse:
         """One KV-cache session step (decode_session.py). Metadata carries

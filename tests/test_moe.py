@@ -628,6 +628,51 @@ def test_decode_sessions_over_rpc():
         server.dht.shutdown()
 
 
+def test_decode_span_execution_across_two_servers():
+    """A 4-block pipeline split over TWO servers pins two 2-block spans: each
+    per-token RPC chains the co-located blocks server-side, and the decoded
+    positions still match the right-padded full recompute exactly."""
+    import uuid
+    from hivemind_tpu.moe import RemoteSequential
+
+    server_a = Server.create(
+        expert_uids=["span.0", "span.1"], expert_cls="causal_transformer", hidden_dim=16,
+        start=True, optim_factory=lambda: optax.sgd(1e-4),
+    )
+    server_b = Server.create(
+        expert_uids=["span.2", "span.3"], expert_cls="causal_transformer", hidden_dim=16,
+        dht=None, start=True, optim_factory=lambda: optax.sgd(1e-4),
+        initial_peers=[str(m) for m in server_a.dht.get_visible_maddrs()],
+    )
+    client_dht = None
+    try:
+        import time
+        time.sleep(1.5)
+        client_dht = DHT(initial_peers=[str(m) for m in server_a.dht.get_visible_maddrs()], start=True)
+        pipe = RemoteSequential(client_dht, "span.", 4)
+
+        rng = np.random.RandomState(11)
+        hidden = rng.randn(1, 7, 16).astype(np.float32)
+        session = uuid.uuid4().hex
+        out_prefill = pipe.decode_step(hidden[:, :5], session, reset=True)
+        route = pipe._decode_routes[session]
+        assert [len(span) for _block, span in route] == [2, 2], route  # two 2-block spans
+        step_outs = [pipe.decode_step(hidden[:, t:t + 1], session) for t in (5, 6)]
+
+        padded = np.zeros((1, 64, 16), np.float32)
+        padded[:, :7] = hidden
+        full = np.asarray(pipe(jnp.asarray(padded)))
+        np.testing.assert_allclose(out_prefill, full[:, :5], rtol=1e-5, atol=1e-5)
+        for offset, out in enumerate(step_outs):
+            np.testing.assert_allclose(out, full[:, 5 + offset:6 + offset], rtol=1e-5, atol=1e-5)
+    finally:
+        if client_dht is not None:
+            client_dht.shutdown()
+        for server in (server_b, server_a):
+            server.shutdown()
+            server.dht.shutdown()
+
+
 def test_decode_continuous_batching_many_clients():
     """Concurrent single-token steps from MANY client sessions are merged into one
     vmapped device call (continuous batching) — every client's tokens must match
