@@ -45,6 +45,7 @@ class RemoteExpert:
     def __init__(self, expert_info: ExpertInfo, p2p: P2P):
         self.expert_info = expert_info
         self.p2p = p2p
+        self.span: Optional[List[str]] = None  # see _span_metadata
         self._info: Optional[Dict[str, Any]] = None
         self._info_lock = threading.Lock()
 
@@ -112,7 +113,9 @@ class RemoteExpert:
         return await deserialize_tensor_stream(parts())
 
     def forward_np(self, *xs: np.ndarray) -> List[np.ndarray]:
-        return RemoteExpertWorker.run_coroutine(self._call("forward", list(xs)))
+        return RemoteExpertWorker.run_coroutine(
+            self._call("forward", list(xs), self._span_metadata())
+        )
 
     def decode_np(
         self, x: np.ndarray, session_id: str, reset: bool = False, span: Optional[list] = None
@@ -138,7 +141,18 @@ class RemoteExpert:
 
     def backward_np(self, *tensors: np.ndarray) -> List[np.ndarray]:
         """``tensors`` = forward inputs followed by one grad per output."""
-        return RemoteExpertWorker.run_coroutine(self._call("backward", list(tensors)))
+        return RemoteExpertWorker.run_coroutine(
+            self._call("backward", list(tensors), self._span_metadata())
+        )
+
+    def _span_metadata(self) -> bytes:
+        """Span execution (``self.span``: uids of consecutive co-located blocks,
+        first = this uid): forward/backward requests carry the chain so the server
+        runs every block of the span in one RPC."""
+        if not self.span:
+            return b""
+        assert self.span[0] == self.uid, (self.span, self.uid)
+        return MSGPackSerializer.dumps({"uids": list(self.span)})
 
     # ------------------------------------------------------------------ jax surface
 

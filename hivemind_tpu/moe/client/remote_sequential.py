@@ -92,7 +92,9 @@ class RemoteSequential:
         self.update_period, self.max_retries = update_period, max_retries
         self.p2p = get_loop_runner().run_coroutine(dht.replicate_p2p())
         self._blocks: Dict[int, _ResilientBlock] = {}
+        self._infos: Dict[int, ExpertInfo] = {}
         self._resolved_at: Dict[int, float] = {}
+        self._span_support: Dict[object, bool] = {}  # peer_id -> server groups spans
         self._decode_routes: Dict[str, list] = {}  # session_id -> pinned block handles
         self.max_decode_routes = 256  # oldest pinned routes drop beyond this
         self._lock = threading.Lock()
@@ -106,12 +108,14 @@ class RemoteSequential:
     def _resolve_info(self, index: int, force: bool = False) -> ExpertInfo:
         with self._lock:
             fresh_enough = time.monotonic() - self._resolved_at.get(index, -1e9) < self.update_period
-            if not force and index in self._blocks and fresh_enough:
-                return self._blocks[index].expert_info
+            cached = self._infos.get(index)
+            if not force and cached is not None and fresh_enough:
+                return cached
         [info] = get_experts(self.dht, [self.block_uid(index)])
         if info is None:
             raise RuntimeError(f"no server declares block {self.block_uid(index)!r}")
         with self._lock:
+            self._infos[index] = info
             self._resolved_at[index] = time.monotonic()
         return info
 
@@ -130,12 +134,129 @@ class RemoteSequential:
     def _call_block(self, index: int, x: jax.Array) -> jax.Array:
         return self._block(index)(x)
 
+    def _peer_supports_spans(self, head: RemoteExpert) -> bool:
+        """Capability negotiation for mixed swarms: a span-unaware server would run
+        only the head block and silently return its output as the whole span's —
+        so multi-block groups require the server to advertise span_support."""
+        supported = self._span_support.get(head.peer_id)
+        if supported is None:
+            try:
+                supported = bool(head.info.get("span_support"))
+            except Exception:
+                supported = False
+            with self._lock:
+                self._span_support[head.peer_id] = supported
+        return supported
+
+    def _grouped_range(self, start: int, stop: int, force: bool = False):
+        """Resolve blocks [start, stop) and group CONSECUTIVE same-peer blocks into
+        spans: each group is one RPC (server chains the blocks — span execution)."""
+        blocks = [
+            RemoteExpert(self._resolve_info(index, force=force), self.p2p)
+            for index in range(start, stop)
+        ]
+        groups = []
+        for block in blocks:
+            if (
+                groups
+                and groups[-1][0].peer_id == block.peer_id
+                and self._peer_supports_spans(groups[-1][0])
+            ):
+                groups[-1][1].append(block.uid)
+            else:
+                groups.append((block, [block.uid]))
+        for head, uids in groups:
+            head.span = uids if len(uids) > 1 else None
+        return groups
+
+    def _span_forward(self, start: int, stop: int, x):
+        last_error: Optional[Exception] = None
+        for attempt in range(self.max_retries + 1):
+            try:
+                for head, _uids in self._grouped_range(start, stop, force=attempt > 0):
+                    x = head.forward_np(x)[0]
+                return x
+            except Exception as e:
+                last_error = e
+                logger.warning(f"span forward [{start}, {stop}) failed (attempt {attempt + 1}): {e!r}")
+        raise RuntimeError(f"span forward [{start}, {stop}) failed after retries") from last_error
+
+    def _span_backward(self, start: int, stop: int, x, grad):
+        """Chained backward over the range. With one co-located span the server does
+        everything in a single RPC; across several servers the boundary activations
+        are recovered with one forward sweep first (the client keeps no residuals).
+
+        Every backward RPC steps the serving blocks' optimizers, so a retry must
+        NEVER replay a group whose backward already succeeded — progress is tracked
+        as a shrinking [start, remaining) range and only the remainder is retried
+        (forward sweeps are side-effect-free and safe to re-run)."""
+        last_error: Optional[Exception] = None
+        remaining = stop
+        for attempt in range(self.max_retries + 1):
+            if remaining <= start:
+                return grad
+            try:
+                groups = self._grouped_range(start, remaining, force=attempt > 0)
+                boundary_inputs, current = [], x
+                for head, _uids in groups:
+                    boundary_inputs.append(current)
+                    if head is not groups[-1][0]:
+                        current = head.forward_np(current)[0]
+                for (head, uids), block_input in zip(reversed(groups), reversed(boundary_inputs)):
+                    grad = head.backward_np(block_input, grad)[0]
+                    remaining -= len(uids)  # this group's optimizers have stepped
+                return grad
+            except Exception as e:
+                last_error = e
+                logger.warning(
+                    f"span backward [{start}, {remaining}) failed (attempt {attempt + 1}): {e!r}"
+                )
+        raise RuntimeError(f"span backward [{start}, {stop}) failed after retries") from last_error
+
     def __call__(self, x: jax.Array, start: int = 0, stop: Optional[int] = None) -> jax.Array:
-        """Run blocks [start, stop) in order; differentiable end to end."""
+        """Run blocks [start, stop) in order; differentiable end to end. Co-located
+        consecutive blocks execute as server-side spans (one RPC per SERVER, not per
+        block — both directions), with re-resolution retries inside the callbacks."""
+        import numpy as np
+
+        import jax.numpy as jnp
+
         stop = stop if stop is not None else self.num_blocks
-        for index in range(start, stop):
-            x = self._call_block(index, x)
-        return x
+        if start >= stop:
+            return x
+        out_schemas = self._block(stop - 1).info["outputs_schema"]
+        assert len(out_schemas) == 1, "RemoteSequential chains single-tensor blocks"
+        out_struct = jax.ShapeDtypeStruct((x.shape[0], *out_schemas[0].shape[1:]), jnp.float32)
+        sequential = self
+
+        @jax.custom_vjp
+        def remote_span(x):
+            return jax.pure_callback(
+                lambda a: np.asarray(
+                    sequential._span_forward(start, stop, np.asarray(a)), np.float32
+                ),
+                out_struct,
+                x,
+            )
+
+        def fwd(x):
+            return remote_span(x), x
+
+        def bwd(residual_x, g):
+            grad_struct = jax.ShapeDtypeStruct(residual_x.shape, jnp.float32)
+            grad = jax.pure_callback(
+                lambda a, gg: np.asarray(
+                    sequential._span_backward(start, stop, np.asarray(a), np.asarray(gg)),
+                    np.float32,
+                ),
+                grad_struct,
+                residual_x,
+                g,
+            )
+            return (grad.astype(residual_x.dtype),)
+
+        remote_span.defvjp(fwd, bwd)
+        return remote_span(x)
 
     def decode_step(self, x, session_id: str, reset: bool = False):
         """Chain one KV-cache decode-session step through every block: the prefill
@@ -156,16 +277,7 @@ class RemoteSequential:
             # pinning them would let the route silently move to a cache-less peer.
             # Consecutive blocks on the SAME peer form a span served by one RPC
             # (Petals-style span execution): per-token round-trips = #servers.
-            blocks = [
-                RemoteExpert(self._resolve_info(index), self.p2p)
-                for index in range(self.num_blocks)
-            ]
-            pinned = []  # [(first_block, [uid, uid, ...]), ...]
-            for block in blocks:
-                if pinned and pinned[-1][0].peer_id == block.peer_id:
-                    pinned[-1][1].append(block.uid)
-                else:
-                    pinned.append((block, [block.uid]))
+            pinned = self._grouped_range(0, self.num_blocks)
             with self._lock:
                 self._decode_routes[session_id] = pinned
                 while len(self._decode_routes) > self.max_decode_routes:

@@ -53,6 +53,7 @@ class ConnectionHandler(ServicerBase):
         if backend is None:
             raise KeyError(f"unknown expert {request.uid!r}")
         info = backend.get_info()
+        info["span_support"] = True  # clients only group co-located blocks if set
         if self.decode_sessions.supports(request.uid):
             info["decode_max_len"] = self.decode_sessions.max_len
         return runtime_pb2.ExpertInfoResponse(serialized_info=MSGPackSerializer.dumps(info))
@@ -78,14 +79,55 @@ class ConnectionHandler(ServicerBase):
         )
         return await pool.submit_task(*tensors)
 
+    def _span_uids(self, uid: str, metadata: bytes) -> List[str]:
+        """Span execution: request metadata may name CONSECUTIVE co-located blocks
+        (``{"uids": [...]}`` starting with the request uid) to run as one chain —
+        per-call round-trips for a pipeline drop from #blocks to #servers."""
+        meta = MSGPackSerializer.loads(metadata) if metadata else {}
+        uids = meta.get("uids") or [uid]
+        if uids[0] != uid:
+            raise ValueError(f"span uids must start with the request uid {uid!r}, got {uids!r}")
+        for prev, nxt in zip(uids, uids[1:]):
+            prev_backend, next_backend = self.backends.get(prev), self.backends.get(nxt)
+            if prev_backend is None or next_backend is None:
+                raise KeyError(f"unknown expert in span: {prev!r} or {nxt!r}")
+            if prev_backend.num_outputs != next_backend.num_inputs:
+                raise ValueError(
+                    f"span chain mismatch: {prev!r} outputs {prev_backend.num_outputs} "
+                    f"tensors but {nxt!r} takes {next_backend.num_inputs}"
+                )
+        return uids
+
+    async def _run_forward_span(self, uids: List[str], tensors: List[np.ndarray]) -> List[np.ndarray]:
+        for span_uid in uids:
+            tensors = await self._run_forward(span_uid, tensors)
+        return tensors
+
+    async def _run_backward_span(self, uids: List[str], tensors: List[np.ndarray]) -> List[np.ndarray]:
+        """Chained backward: recover each block's inputs with a forward sweep, then
+        backpropagate block by block in reverse (every block's backward also steps
+        its optimizer — same semantics as per-block RPCs)."""
+        first = self.backends[uids[0]]
+        block_inputs, current = [], tensors[: first.num_inputs]
+        for span_uid in uids:
+            block_inputs.append(current)
+            if span_uid != uids[-1]:
+                current = await self._run_forward(span_uid, current)
+        grads = tensors[first.num_inputs:]
+        for span_uid, inputs in zip(reversed(uids), reversed(block_inputs)):
+            grads = await self._run_backward(span_uid, [*inputs, *grads])
+        return grads
+
     async def rpc_forward(self, request: runtime_pb2.ExpertRequest, context: P2PContext) -> runtime_pb2.ExpertResponse:
         inputs = [deserialize_tensor(t) for t in request.tensors]
-        outputs = await self._run_forward(request.uid, inputs)
+        uids = self._span_uids(request.uid, request.metadata)
+        outputs = await self._run_forward_span(uids, inputs)
         return runtime_pb2.ExpertResponse(tensors=[serialize_tensor(o) for o in outputs])
 
     async def rpc_backward(self, request: runtime_pb2.ExpertRequest, context: P2PContext) -> runtime_pb2.ExpertResponse:
         inputs = [deserialize_tensor(t) for t in request.tensors]
-        grads = await self._run_backward(request.uid, inputs)
+        uids = self._span_uids(request.uid, request.metadata)
+        grads = await self._run_backward_span(uids, inputs)
         return runtime_pb2.ExpertResponse(tensors=[serialize_tensor(g) for g in grads])
 
     async def _run_decode(self, uid: str, metadata: bytes, tensors: List[np.ndarray]) -> np.ndarray:
@@ -95,12 +137,9 @@ class ConnectionHandler(ServicerBase):
             raise ValueError("rpc_decode requires a session_id in request metadata")
         [x] = tensors
         # span execution: chain consecutive co-located pipeline blocks' session
-        # steps in ONE rpc (uids[0] must be the request uid); each per-uid step
-        # still goes through decode_async, so cross-client continuous batching
-        # applies at every block of the span
-        uids = meta.get("uids") or [uid]
-        if uids[0] != uid:
-            raise ValueError(f"span uids must start with the request uid {uid!r}, got {uids!r}")
+        # steps in ONE rpc; each per-uid step still goes through decode_async, so
+        # cross-client continuous batching applies at every block of the span
+        uids = self._span_uids(uid, metadata)
         reset = bool(meta.get("reset", False))
         for span_uid in uids:
             x = await self.decode_sessions.decode_async(span_uid, str(session_id), x, reset)
@@ -126,37 +165,22 @@ class ConnectionHandler(ServicerBase):
     async def rpc_forward_stream(
         self, requests: AsyncIterator[runtime_pb2.ExpertRequest], context: P2PContext
     ) -> AsyncIterator[runtime_pb2.ExpertResponse]:
-        uid, tensors = await self._collect_stream(requests)
-        outputs = await self._run_forward(uid, tensors)
+        uid, metadata, tensors = await self._collect_stream_with_metadata(requests)
+        outputs = await self._run_forward_span(self._span_uids(uid, metadata), tensors)
         for message in self._stream_response(outputs):
             yield message
 
     async def rpc_backward_stream(
         self, requests: AsyncIterator[runtime_pb2.ExpertRequest], context: P2PContext
     ) -> AsyncIterator[runtime_pb2.ExpertResponse]:
-        uid, tensors = await self._collect_stream(requests)
-        grads = await self._run_backward(uid, tensors)
+        uid, metadata, tensors = await self._collect_stream_with_metadata(requests)
+        grads = await self._run_backward_span(self._span_uids(uid, metadata), tensors)
         for message in self._stream_response(grads):
             yield message
 
     @staticmethod
-    async def _collect_stream(requests: AsyncIterator[runtime_pb2.ExpertRequest]):
-        uid = None
-
-        async def parts():
-            nonlocal uid
-            async for request in requests:
-                if uid is None and request.uid:
-                    uid = request.uid
-                yield list(request.tensors)
-
-        tensors = await deserialize_tensor_stream(parts())
-        assert uid is not None, "stream carried no expert uid"
-        return uid, tensors
-
-    @staticmethod
     async def _collect_stream_with_metadata(requests: AsyncIterator[runtime_pb2.ExpertRequest]):
-        """Like _collect_stream, additionally capturing the FIRST message's metadata."""
+        """Collect a streamed request: uid + first message's metadata + tensors."""
         uid = None
         metadata = b""
 

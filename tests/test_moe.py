@@ -665,6 +665,23 @@ def test_decode_span_execution_across_two_servers():
         np.testing.assert_allclose(out_prefill, full[:, :5], rtol=1e-5, atol=1e-5)
         for offset, out in enumerate(step_outs):
             np.testing.assert_allclose(out, full[:, 5 + offset:6 + offset], rtol=1e-5, atol=1e-5)
+
+        # training across the span boundary: gradients flow through both servers'
+        # spans (client recovers the boundary activation with one forward sweep)
+        # and every block's server-side optimizer steps
+        counts_before = [
+            server_a.backends["span.0"].update_count, server_a.backends["span.1"].update_count,
+            server_b.backends["span.2"].update_count, server_b.backends["span.3"].update_count,
+        ]
+        grads = jax.grad(lambda xx: jnp.sum(pipe(xx) ** 2))(jnp.asarray(padded))
+        assert grads.shape == padded.shape and bool(jnp.isfinite(grads).all())
+        counts_after = [
+            server_a.backends["span.0"].update_count, server_a.backends["span.1"].update_count,
+            server_b.backends["span.2"].update_count, server_b.backends["span.3"].update_count,
+        ]
+        assert all(after == before + 1 for before, after in zip(counts_before, counts_after)), (
+            counts_before, counts_after,
+        )
     finally:
         if client_dht is not None:
             client_dht.shutdown()
