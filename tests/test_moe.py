@@ -690,6 +690,46 @@ def test_decode_span_execution_across_two_servers():
             server.dht.shutdown()
 
 
+def test_span_fallback_for_span_unaware_server():
+    """Mixed-swarm capability negotiation: when a server does not advertise
+    span_support (an older build would run only the head block and silently
+    return a wrong result), the client must fall back to per-block calls."""
+    import uuid
+    from hivemind_tpu.moe import RemoteSequential
+
+    server = Server.create(
+        expert_uids=["nospan.0", "nospan.1"], expert_cls="causal_transformer", hidden_dim=16,
+        start=True, optim_factory=lambda: optax.sgd(1e-4),
+    )
+    client_dht = None
+    try:
+        import time
+        time.sleep(1.0)
+        client_dht = DHT(initial_peers=[str(m) for m in server.dht.get_visible_maddrs()], start=True)
+        pipe = RemoteSequential(client_dht, "nospan.", 2)
+
+        # this server DOES advertise span support: grouping forms one 2-block span
+        groups = pipe._grouped_range(0, 2)
+        assert [len(uids) for _head, uids in groups] == [2], groups
+        peer_id = groups[0][0].peer_id
+
+        # a span-unaware peer (negative capability cache, as _peer_supports_spans
+        # records after probing an older server's rpc_info) falls back to
+        # per-block grouping — and the pipeline still computes correctly
+        pipe._span_support[peer_id] = False
+        groups = pipe._grouped_range(0, 2)
+        assert [len(uids) for _head, uids in groups] == [1, 1], groups
+        assert all(head.span is None for head, _uids in groups)
+        x = jnp.asarray(np.random.RandomState(5).randn(1, 64, 16), jnp.float32)
+        out = pipe(x)
+        assert out.shape == x.shape and bool(jnp.isfinite(out).all())
+    finally:
+        if client_dht is not None:
+            client_dht.shutdown()
+        server.shutdown()
+        server.dht.shutdown()
+
+
 def test_decode_continuous_batching_many_clients():
     """Concurrent single-token steps from MANY client sessions are merged into one
     vmapped device call (continuous batching) — every client's tokens must match
