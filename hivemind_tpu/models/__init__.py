@@ -7,3 +7,10 @@ from hivemind_tpu.models.albert import (
     make_train_step,
     mlm_loss,
 )
+from hivemind_tpu.models.causal_lm import (
+    CausalLM,
+    CausalLMConfig,
+    causal_lm_loss,
+    make_synthetic_lm_batch,
+)
+from hivemind_tpu.models.causal_lm import make_train_step as make_causal_train_step

@@ -18,7 +18,6 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from hivemind_tpu.parallel.ring_attention import plain_attention, ring_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -56,33 +55,9 @@ class AlbertConfig:
 
 
 def _attention_core(config: AlbertConfig, q, k, v, mask):
-    mesh = config.mesh
-    if mesh is not None and mesh.shape.get("sp", 1) > 1:
-        from jax import shard_map
-        from jax.sharding import PartitionSpec as P
+    from hivemind_tpu.parallel.ring_attention import mesh_attention_core
 
-        from hivemind_tpu.ops.pallas_attention import _flash_enabled
-        from hivemind_tpu.parallel.ring_attention import ring_flash_attention
-
-        spec = P("dp", "sp", "tp" if mesh.shape.get("tp", 1) > 1 else None, None)
-        extra = {}
-        if _flash_enabled() and jax.default_backend() == "tpu":
-            # flash core per ring step: scores stay in VMEM, shard outputs merge
-            # via log-sum-exp — the long-context configuration. check_vma off:
-            # the varying-axes checker cannot see through pallas_call outputs.
-            inner = partial(ring_flash_attention, axis_name="sp")
-            extra["check_vma"] = False
-        else:
-            inner = partial(ring_attention, axis_name="sp")
-        core = shard_map(
-            inner,
-            mesh=mesh,
-            in_specs=(spec, spec, spec),
-            out_specs=spec,
-            **extra,
-        )
-        return core(q, k, v)
-    return plain_attention(q, k, v, mask)
+    return mesh_attention_core(config.mesh, q, k, v, mask=mask)
 
 
 class AlbertLayer(nn.Module):

@@ -694,7 +694,6 @@ def test_span_fallback_for_span_unaware_server():
     """Mixed-swarm capability negotiation: when a server does not advertise
     span_support (an older build would run only the head block and silently
     return a wrong result), the client must fall back to per-block calls."""
-    import uuid
     from hivemind_tpu.moe import RemoteSequential
 
     server = Server.create(
