@@ -20,6 +20,9 @@ import numpy as np
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--run_id", default="albert_demo")
+    parser.add_argument("--model", choices=("albert", "causal"), default="albert",
+                        help="albert: masked-LM flagship; causal: decoder-only "
+                             "next-token pretraining (models/causal_lm.py)")
     parser.add_argument("--initial_peers", nargs="*", default=[])
     parser.add_argument("--target_batch_size", type=int, default=4096)
     parser.add_argument("--batch_size", type=int, default=16)
@@ -59,13 +62,30 @@ def main():
     for maddr in dht.get_visible_maddrs():
         logger.info(f"to join this training run: --initial_peers {maddr}")
 
-    config = AlbertConfig.tiny(max_position=args.seq_len) if args.tiny else AlbertConfig.base(max_position=args.seq_len)
-    model = AlbertForMaskedLM(config)
-    sample = make_synthetic_mlm_batch(jax.random.PRNGKey(0), config, args.batch_size, args.seq_len)
-    params = model.init(jax.random.PRNGKey(0), sample["input_ids"][:1, :8])["params"]
+    if args.model == "causal":
+        from hivemind_tpu.models import CausalLM, CausalLMConfig, causal_lm_loss
 
-    # masked-only loss: ~4x cheaper MLM head (same objective at 15% masking)
-    loss_fn = make_mlm_loss_fn(model, masked_loss_fraction=0.25)
+        config = (
+            CausalLMConfig.tiny(max_position=args.seq_len) if args.tiny
+            else CausalLMConfig.base(max_position=args.seq_len)
+        )
+        model = CausalLM(config)
+        sample = make_synthetic_mlm_batch(jax.random.PRNGKey(0), config, args.batch_size, args.seq_len)
+        params = model.init(jax.random.PRNGKey(0), sample["input_ids"][:1, :8])["params"]
+
+        def loss_fn(params, batch):
+            # the sampler's "labels" field is the UNMASKED token stream — exactly
+            # what next-token prediction trains on
+            tokens = batch["labels"]
+            return causal_lm_loss(model.apply({"params": params}, tokens), tokens)
+    else:
+        config = AlbertConfig.tiny(max_position=args.seq_len) if args.tiny else AlbertConfig.base(max_position=args.seq_len)
+        model = AlbertForMaskedLM(config)
+        sample = make_synthetic_mlm_batch(jax.random.PRNGKey(0), config, args.batch_size, args.seq_len)
+        params = model.init(jax.random.PRNGKey(0), sample["input_ids"][:1, :8])["params"]
+
+        # masked-only loss: ~4x cheaper MLM head (same objective at 15% masking)
+        loss_fn = make_mlm_loss_fn(model, masked_loss_fraction=0.25)
 
     @jax.jit
     def loss_and_grad(params, batch):

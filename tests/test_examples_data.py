@@ -84,6 +84,22 @@ def test_shared_vocab_across_peers(tmp_path, corpus):
         make_batch_sampler(AlbertConfig.tiny(max_position=16), 16, hf_tokenizer="bert-base-uncased")
 
 
+def test_run_trainer_causal_model_smoke():
+    """--model causal trains the decoder-only family through the same recipe: a
+    single peer advances solo epochs and exits cleanly."""
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    script = os.path.join(repo, "examples", "albert", "run_trainer.py")
+    env = {**os.environ, "PYTHONPATH": repo}
+    run = subprocess.run(
+        [sys.executable, script, "--model", "causal", "--tiny", "--platform", "cpu",
+         "--run_id", "causal_smoke", "--max_steps", "6", "--target_batch_size", "32",
+         "--batch_size", "16", "--seq_len", "64", "--matchmaking_time", "0.5", "--seed", "0"],
+        stderr=subprocess.PIPE, text=True, cwd=repo, timeout=180, env=env,
+    )
+    assert run.returncode == 0, run.stderr[-3000:]
+    assert re.search(r"training finished after 6 steps at epoch (\d+)", run.stderr), run.stderr[-2000:]
+
+
 def test_run_trainer_two_peer_smoke():
     """The flagship recipe end-to-end: two run_trainer.py processes (tiny config,
     synthetic data) form a swarm, advance epochs together, and exit cleanly after
