@@ -163,8 +163,19 @@ class RemoteExpert:
         expert's declared schemas with this call's batch size."""
         out_schemas = self.info["outputs_schema"]
         batch = xs[0].shape[0]
+        # the server's schema reflects ITS sample batch: when the rank matches this
+        # call's input, the expert preserves leading dims (batch, seq, ...) and only
+        # the feature dim follows the schema — a sample-length seq baked into
+        # out_structs would shape-mismatch any other sequence length. Rank-changing
+        # experts (e.g. pooling) keep the schema's trailing dims as declared.
         out_structs = tuple(
-            jax.ShapeDtypeStruct((batch, *schema.shape[1:]), jnp.float32) for schema in out_schemas
+            jax.ShapeDtypeStruct(
+                (*xs[0].shape[:-1], schema.shape[-1])
+                if len(schema.shape) == xs[0].ndim
+                else (batch, *schema.shape[1:]),
+                jnp.float32,
+            )
+            for schema in out_schemas
         )
         single_output = len(out_structs) == 1
         expert = self

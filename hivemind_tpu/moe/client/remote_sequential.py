@@ -143,7 +143,10 @@ class RemoteSequential:
             try:
                 supported = bool(head.info.get("span_support"))
             except Exception:
-                supported = False
+                # transient info failure: assume no spans THIS grouping, but do not
+                # cache the negative — a single failed fetch must not disable span
+                # grouping for this peer for the process lifetime
+                return False
             with self._lock:
                 self._span_support[head.peer_id] = supported
         return supported
@@ -170,12 +173,17 @@ class RemoteSequential:
         return groups
 
     def _span_forward(self, start: int, stop: int, x):
+        """Each attempt restarts from the ORIGINAL input: a mid-chain failure would
+        otherwise retry the whole range on a partially-advanced activation, silently
+        double-applying the blocks that already ran (corrupting the custom_vjp
+        primal on exactly the failover path the retry exists for)."""
         last_error: Optional[Exception] = None
         for attempt in range(self.max_retries + 1):
             try:
+                current = x
                 for head, _uids in self._grouped_range(start, stop, force=attempt > 0):
-                    x = head.forward_np(x)[0]
-                return x
+                    current = head.forward_np(current)[0]
+                return current
             except Exception as e:
                 last_error = e
                 logger.warning(f"span forward [{start}, {stop}) failed (attempt {attempt + 1}): {e!r}")
@@ -226,7 +234,9 @@ class RemoteSequential:
             return x
         out_schemas = self._block(stop - 1).info["outputs_schema"]
         assert len(out_schemas) == 1, "RemoteSequential chains single-tensor blocks"
-        out_struct = jax.ShapeDtypeStruct((x.shape[0], *out_schemas[0].shape[1:]), jnp.float32)
+        # blocks preserve batch and sequence dims; only the FEATURE dim follows the
+        # server's schema (whose leading dims reflect its sample batch, not ours)
+        out_struct = jax.ShapeDtypeStruct((*x.shape[:-1], out_schemas[0].shape[-1]), jnp.float32)
         sequential = self
 
         @jax.custom_vjp

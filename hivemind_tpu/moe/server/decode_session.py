@@ -79,6 +79,7 @@ class DecodeSessionManager:
         self._dummy_caches: Dict[str, tuple] = {}  # per-uid padding rows for pow2 buckets
         self._lock = threading.Lock()
         self._pending: Dict[str, List] = {}  # uid -> [(future, session, x), ...]
+        self._in_flight: Dict[int, int] = {}  # id(session) -> refcount, during _decode_batch
         self._drainers: Dict[str, asyncio.Task] = {}
         self.batching_enabled = os.environ.get("HIVEMIND_TPU_DECODE_BATCHING", "1") != "0"
 
@@ -88,11 +89,26 @@ class DecodeSessionManager:
 
     def _evict_locked(self) -> None:
         now = time.monotonic()
-        expired = [k for k, s in self._sessions.items() if now - s.last_used > self.session_ttl]
+        # sessions with an enqueued-but-unresolved batched step are pinned: evicting
+        # one mid-flight would orphan its cache object — the step would "succeed"
+        # against the orphan and the client's next continuation would KeyError.
+        # _in_flight covers the window after _drain pops entries out of _pending but
+        # before _decode_batch finishes (the device call itself).
+        pinned = {
+            id(session)
+            for entries in self._pending.values()
+            for (_future, session, _x) in entries
+        } | set(self._in_flight)
+        expired = [
+            k for k, s in self._sessions.items()
+            if now - s.last_used > self.session_ttl and id(s) not in pinned
+        ]
         for key in expired:
             del self._sessions[key]
-        while len(self._sessions) > self.max_sessions:
-            oldest = min(self._sessions, key=lambda k: self._sessions[k].last_used)
+        evictable = [k for k in self._sessions if id(self._sessions[k]) not in pinned]
+        while len(self._sessions) > self.max_sessions and evictable:
+            oldest = min(evictable, key=lambda k: self._sessions[k].last_used)
+            evictable.remove(oldest)
             del self._sessions[oldest]
 
     def _raw_step(self, uid: str):
@@ -190,16 +206,19 @@ class DecodeSessionManager:
         if not batchable:
             return await loop.run_in_executor(None, self.decode, uid, session_id, x, reset)
 
-        with self._lock:
-            self._evict_locked()  # the direct path evicts in decode(); mirror it here
-            session = self._sessions.get((uid, session_id))
-        if session is None:
-            raise KeyError(
-                f"unknown or expired decode session {session_id!r} for {uid!r}; "
-                f"restart generation with reset=True"
-            )
         future = loop.create_future()
         with self._lock:
+            # lookup + enqueue under ONE lock hold: releasing in between would let
+            # _evict_locked delete the session while this step is pending, so the
+            # step would update an orphaned cache and the next continuation KeyErrors
+            self._evict_locked()  # the direct path evicts in decode(); mirror it here
+            session = self._sessions.get((uid, session_id))
+            if session is None:
+                raise KeyError(
+                    f"unknown or expired decode session {session_id!r} for {uid!r}; "
+                    f"restart generation with reset=True"
+                )
+            session.last_used = time.monotonic()
             self._pending.setdefault(uid, []).append((future, session, x))
             if uid not in self._drainers or self._drainers[uid].done():
                 self._drainers[uid] = loop.create_task(self._drain(uid))
@@ -210,6 +229,10 @@ class DecodeSessionManager:
         await asyncio.sleep(self.flush_window)  # let concurrent streams pile up
         with self._lock:
             entries = self._pending.pop(uid, [])
+            for _future, session, _x in entries:
+                # keep the eviction pin through the device call: the entries leave
+                # _pending now but their caches are updated until the batch resolves
+                self._in_flight[id(session)] = self._in_flight.get(id(session), 0) + 1
         if not entries:
             return
         # one session must not appear twice in a batch (its cache would fork):
@@ -237,6 +260,12 @@ class DecodeSessionManager:
         # drainer and only enqueued) — and any same-session rollover — need a fresh
         # drainer now, or they would strand until some future call happens to spawn one
         with self._lock:
+            for _future, session, _x in entries:
+                count = self._in_flight.get(id(session), 0) - 1
+                if count > 0:
+                    self._in_flight[id(session)] = count
+                else:
+                    self._in_flight.pop(id(session), None)
             if rollover:
                 self._pending.setdefault(uid, []).extend(rollover)
             if self._pending.get(uid):

@@ -90,11 +90,22 @@ class RelayChannel:
             self.writer.close()
 
 
+# host:port endpoints that EVER completed an encrypted handshake in this process:
+# once a relay has proven it can do crypto, a later handshake "failure" is treated
+# as an active downgrade attempt, not a legacy daemon
+_ENCRYPTED_ENDPOINTS: set = set()
+
+
 async def open_relay_channel(
-    host: str, port: int, relay_pubkey: Optional[bytes] = None
+    host: str, port: int, relay_pubkey: Optional[bytes] = None,
+    require_encryption: bool = False,
 ) -> RelayChannel:
     """Connect and negotiate the encrypted control channel. Falls back to plaintext
-    only when the daemon cannot do crypto AND no ``relay_pubkey`` pin was given."""
+    only when the daemon cannot do crypto AND no ``relay_pubkey`` pin was given AND
+    this endpoint never completed an encrypted handshake before (an on-path attacker
+    interfering with the handshake must not be able to strip encryption from an
+    endpoint known to support it). ``require_encryption=True`` forbids the fallback
+    entirely."""
     reader, writer = await asyncio.open_connection(host, port)
     ephemeral = X25519PrivateKey.generate()
     eph_pub = ephemeral.public_key().public_bytes(
@@ -114,6 +125,18 @@ async def open_relay_channel(
         if relay_pubkey is not None:
             raise ConnectionError("relay does not support the encrypted control channel "
                                   "but a pinned identity was required")
+        if require_encryption:
+            raise ConnectionError(f"relay {host}:{port} did not complete the encrypted "
+                                  f"handshake and require_encryption is set")
+        if (host, port) in _ENCRYPTED_ENDPOINTS:
+            raise ConnectionError(
+                f"relay {host}:{port} previously completed an encrypted handshake but now "
+                f"fails it — refusing the plaintext downgrade (possible on-path attacker)"
+            )
+        logger.warning(
+            f"relay control channel to {host}:{port} is PLAINTEXT (daemon did not complete "
+            f"the encrypted handshake); pass relay_pubkey or require_encryption=True to forbid"
+        )
         reader, writer = await asyncio.open_connection(host, port)
         return RelayChannel(reader, writer)
 
@@ -131,6 +154,7 @@ async def open_relay_channel(
             f"relay identity mismatch: expected {relay_pubkey.hex()}, got {relay_pub.hex()}"
         )
     shared = ephemeral.exchange(X25519PublicKey.from_public_bytes(relay_eph))
+    _ENCRYPTED_ENDPOINTS.add((host, port))
     okm = HKDF(
         algorithm=hashes.SHA256(), length=64, salt=b"hivemind-relay-hs", info=b"control"
     ).derive(shared)
@@ -165,23 +189,27 @@ class RelayClient:
     relayed dials are accepted automatically and served like direct connections.
     ``dial(peer_id)`` connects to a registered peer through the relay."""
 
-    def __init__(self, p2p, host: str, port: int, relay_pubkey: Optional[bytes] = None):
+    def __init__(self, p2p, host: str, port: int, relay_pubkey: Optional[bytes] = None,
+                 require_encryption: bool = False):
         self.p2p = p2p
         self.host, self.port = host, port
         if isinstance(relay_pubkey, str):
             relay_pubkey = bytes.fromhex(relay_pubkey)
         self.relay_pubkey = relay_pubkey  # optional pinned relay identity
+        self.require_encryption = require_encryption  # forbid plaintext fallback
         self._control: Optional[RelayChannel] = None
         self._control_task: Optional[asyncio.Task] = None
 
     @classmethod
-    async def create(cls, p2p, host: str, port: int, relay_pubkey: Optional[bytes] = None) -> "RelayClient":
-        self = cls(p2p, host, port, relay_pubkey=relay_pubkey)
+    async def create(cls, p2p, host: str, port: int, relay_pubkey: Optional[bytes] = None,
+                     require_encryption: bool = False) -> "RelayClient":
+        self = cls(p2p, host, port, relay_pubkey=relay_pubkey, require_encryption=require_encryption)
         await self._register()
         return self
 
     async def _open_channel(self) -> RelayChannel:
-        channel = await open_relay_channel(self.host, self.port, self.relay_pubkey)
+        channel = await open_relay_channel(self.host, self.port, self.relay_pubkey,
+                                           require_encryption=self.require_encryption)
         if channel.encrypted and self.relay_pubkey is None:
             # trust-on-first-use: pin the identity we saw so every later control
             # connection in this client talks to the SAME relay

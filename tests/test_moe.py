@@ -729,6 +729,37 @@ def test_span_fallback_for_span_unaware_server():
         server.dht.shutdown()
 
 
+def test_span_forward_retry_restarts_from_original_input():
+    """Regression: a mid-chain failure must retry from the ORIGINAL input, not the
+    partially-advanced activation — otherwise the blocks that already ran are
+    silently applied twice and the custom_vjp primal is corrupted on exactly the
+    failover path the retry exists for."""
+    from hivemind_tpu.moe import RemoteSequential
+
+    pipe = RemoteSequential.__new__(RemoteSequential)
+    pipe.max_retries = 2
+    calls = {"attempt": 0}
+
+    class FakeHead:
+        def __init__(self, add, fail_once):
+            self.add, self.fail_once = add, fail_once
+
+        def forward_np(self, x):
+            if self.fail_once and calls["attempt"] == 0:
+                calls["attempt"] += 1
+                raise ConnectionError("peer died mid-chain")
+            return (x + self.add,)
+
+    def grouped_range(start, stop, force=False):
+        return [(FakeHead(1.0, fail_once=False), ["b.0"]),
+                (FakeHead(10.0, fail_once=True), ["b.1"])]
+
+    pipe._grouped_range = grouped_range
+    out = pipe._span_forward(0, 2, np.zeros((1,), np.float32))
+    # first attempt applied +1 then died; a buggy retry would re-apply +1 (out=12)
+    assert float(out[0]) == 11.0, out
+
+
 def test_decode_continuous_batching_many_clients():
     """Concurrent single-token steps from MANY client sessions are merged into one
     vmapped device call (continuous batching) — every client's tokens must match
