@@ -64,14 +64,18 @@ def main():
         jax.block_until_ready(back)
         return host
 
+    import resource
+
     host = one_round()  # compile + numerics check
     expected = np.mean(np.asarray(stacked[0]), axis=0)
     np.testing.assert_allclose(host[0], expected, rtol=1e-5, atol=1e-6)
 
+    rss_before = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6  # GB (linux: KB)
     start = time.perf_counter()
     for _ in range(args.num_rounds):
         one_round()
     elapsed = time.perf_counter() - start
+    rss_peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
 
     tensor_bytes = per_leaf * args.num_leaves * 4  # what actually moved (// truncates)
     print(json.dumps({
@@ -82,6 +86,11 @@ def main():
             "devices": n, "params": args.num_params, "leaves": args.num_leaves,
             "rounds": args.num_rounds, "seconds_per_round": round(elapsed / args.num_rounds, 4),
             "backend": jax.default_backend(),
+            "model_gb": round(tensor_bytes / 1e9, 3),
+            # chunked staging claim (VERDICT r2 weak #3): steady-state rounds must
+            # not grow peak RSS by another model copy
+            "peak_rss_gb": round(rss_peak, 3),
+            "rss_growth_during_rounds_gb": round(rss_peak - rss_before, 3),
         },
     }))
 

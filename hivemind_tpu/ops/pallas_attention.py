@@ -17,11 +17,14 @@ KV tile are resident per step. In causal mode, KV blocks entirely above the
 diagonal skip their matmuls via `pl.when` (half the FLOPs of the naive sweep);
 masking within straddling blocks matches `plain_attention` exactly.
 
-Differentiation: `flash_attention` carries a `jax.custom_vjp` whose backward
-recomputes through the reference einsum path — forward gets the fused kernel and
-O(seq) residuals, backward pays one recompute (the standard remat trade; a fused
-backward kernel is future work). On non-TPU backends the kernel runs in interpret
-mode for the test suite; `attention_auto` dispatches per backend."""
+Differentiation: `flash_attention` carries a `jax.custom_vjp` with FUSED backward
+kernels (the standard two-pass scheme): the forward saves (out, lse) as O(seq)
+residuals, then dQ comes from one kernel sweeping KV blocks per query block and
+(dK, dV) from a second kernel sweeping query blocks per KV block — probabilities
+are recomputed per tile from the saved log-sum-exp (`p = exp(s − lse)`, no max
+carry needed), so score matrices never materialize in HBM in either direction.
+On non-TPU backends the kernels run in interpret mode for the test suite;
+`attention_auto` dispatches per backend."""
 
 from __future__ import annotations
 
@@ -142,23 +145,181 @@ def flash_attention_lse(q, k, v, causal: bool = False, interpret: bool = False):
     return _flash_forward(q, k, v, causal=causal, interpret=interpret)
 
 
+def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *, kv_start, q_start, seq_len, causal):
+    """Shared per-tile math of both backward kernels: recompute probabilities from
+    the saved log-sum-exp and return (p, ds) for this (query, KV) tile pair."""
+    q = q_ref[0].astype(jnp.float32)  # [BLOCK_Q, d]
+    k = k_ref[0].astype(jnp.float32)  # [BLOCK_K, d]
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]  # [BLOCK_Q] fp32
+    delta = delta_ref[0]  # [BLOCK_Q] fp32, rowsum(dout * out)
+    scale = q.shape[-1] ** -0.5
+    scores = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    kv_positions = kv_start + jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, BLOCK_K), 1)
+    mask = kv_positions < seq_len  # tail-padding guard; masked p underflows to 0
+    if causal:
+        q_positions = q_start + jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, BLOCK_K), 0)
+        mask &= kv_positions <= q_positions
+    scores = jnp.where(mask, scores, _NEG_INF)
+    p = jnp.exp(scores - lse[:, None])  # exact probs: lse already holds the row max
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None]) * scale
+    return q, k, do, p, ds
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc_ref, *, seq_len, causal
+):
+    """dQ pass: grid (batch·heads, q_blocks, kv_blocks) — for each query block,
+    sweep KV blocks accumulating dQ = Σ dS·K in VMEM scratch."""
+    q_index, kv_index = pl.program_id(1), pl.program_id(2)
+    num_kv = pl.num_programs(2)
+
+    @pl.when(kv_index == 0)
+    def _init():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    kv_start = kv_index * BLOCK_K
+    block_needed = (not causal) or (kv_start <= q_index * BLOCK_Q + BLOCK_Q - 1)
+
+    @pl.when(block_needed)
+    def _accumulate():
+        _q, k, _do, _p, ds = _bwd_tile(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            kv_start=kv_start, q_start=q_index * BLOCK_Q, seq_len=seq_len, causal=causal,
+        )
+        dq_acc_ref[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(kv_index == num_kv - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc_ref[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc_ref, dv_acc_ref, *, seq_len, causal
+):
+    """dK/dV pass: grid (batch·heads, kv_blocks, q_blocks) — for each KV block,
+    sweep query blocks accumulating dV = Σ Pᵀ·dO and dK = Σ dSᵀ·Q in scratch."""
+    kv_index, q_index = pl.program_id(1), pl.program_id(2)
+    num_q = pl.num_programs(2)
+
+    @pl.when(q_index == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    kv_start = kv_index * BLOCK_K
+    # blocks strictly above the diagonal see no probability mass in causal mode
+    block_needed = (not causal) or (q_index * BLOCK_Q + BLOCK_Q - 1 >= kv_start)
+
+    @pl.when(block_needed)
+    def _accumulate():
+        q, _k, do, p, ds = _bwd_tile(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            kv_start=kv_start, q_start=q_index * BLOCK_Q, seq_len=seq_len, causal=causal,
+        )
+        dv_acc_ref[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dk_acc_ref[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(q_index == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+
+@partial(jax.jit, static_argnames=("causal", "interpret"))
+def _flash_backward(q, k, v, out, lse, grad_out, causal: bool = False, interpret: bool = False):
+    """Fused two-pass flash backward from the saved (out, lse) residuals."""
+    batch, seq, heads, head_dim = q.shape
+
+    def to_bh(x, block):
+        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(batch * heads, seq, head_dim)
+        pad = (-seq) % block
+        return jnp.pad(x, ((0, 0), (0, pad), (0, 0))) if pad else x
+
+    def from_bh(x):
+        return jnp.transpose(x[:, :seq].reshape(batch, heads, seq, head_dim), (0, 2, 1, 3))
+
+    qb, dob, outb = to_bh(q, BLOCK_Q), to_bh(grad_out, BLOCK_Q), to_bh(out, BLOCK_Q)
+    kb, vb = to_bh(k, BLOCK_K), to_bh(v, BLOCK_K)
+    padded_q = qb.shape[1]
+    # delta_i = Σ_d dOut·Out — one elementwise reduce; padded rows are zero (dob
+    # is zero-padded), so they contribute nothing to dK/dV in the sweep
+    deltab = jnp.sum(dob.astype(jnp.float32) * outb.astype(jnp.float32), axis=-1)
+    lseb = lse.reshape(batch * heads, seq)  # lse arrives as [batch, heads, seq]
+    pad = padded_q - seq
+    if pad:
+        lseb = jnp.pad(lseb, ((0, 0), (0, pad)))
+
+    num_q, num_kv = padded_q // BLOCK_Q, kb.shape[1] // BLOCK_K
+    q_spec = pl.BlockSpec((1, BLOCK_Q, head_dim), lambda bh, qi, ki: (bh, qi, 0))
+    kv_spec = pl.BlockSpec((1, BLOCK_K, head_dim), lambda bh, qi, ki: (bh, ki, 0))
+    row_spec = pl.BlockSpec((1, BLOCK_Q), lambda bh, qi, ki: (bh, qi))
+    dq = pl.pallas_call(
+        partial(_flash_bwd_dq_kernel, seq_len=seq, causal=causal),
+        grid=(batch * heads, num_q, num_kv),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=pl.BlockSpec((1, BLOCK_Q, head_dim), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((batch * heads, padded_q, head_dim), q.dtype),
+        scratch_shapes=[pltpu.VMEM((BLOCK_Q, head_dim), jnp.float32)],
+        interpret=interpret,
+    )(qb, kb, vb, dob, lseb, deltab)
+    # second pass: grid transposed — (bh, kv block, q block), q fastest-varying
+    q_spec_t = pl.BlockSpec((1, BLOCK_Q, head_dim), lambda bh, ki, qi: (bh, qi, 0))
+    kv_spec_t = pl.BlockSpec((1, BLOCK_K, head_dim), lambda bh, ki, qi: (bh, ki, 0))
+    row_spec_t = pl.BlockSpec((1, BLOCK_Q), lambda bh, ki, qi: (bh, qi))
+    dk, dv = pl.pallas_call(
+        partial(_flash_bwd_dkv_kernel, seq_len=seq, causal=causal),
+        grid=(batch * heads, num_kv, num_q),
+        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t, row_spec_t],
+        out_specs=[
+            pl.BlockSpec((1, BLOCK_K, head_dim), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, BLOCK_K, head_dim), lambda bh, ki, qi: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch * heads, kb.shape[1], head_dim), k.dtype),
+            jax.ShapeDtypeStruct((batch * heads, kb.shape[1], head_dim), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK_K, head_dim), jnp.float32),
+            pltpu.VMEM((BLOCK_K, head_dim), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qb, kb, vb, dob, lseb, deltab)
+    return from_bh(dq), from_bh(dk), from_bh(dv)
+
+
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(q, k, v, causal: bool = False, interpret: bool = False):
     """Fused flash attention on [batch, seq, heads, head_dim] (full sequences; for
-    padded batches use the mask-capable `plain_attention`). Grad = recompute."""
+    padded batches use the mask-capable `plain_attention`). Backward is fused too
+    (two-pass kernels from the saved log-sum-exp — see module docstring)."""
     return _flash_forward(q, k, v, causal=causal, interpret=interpret)[0]
 
 
 def _flash_fwd(q, k, v, causal, interpret):
-    return _flash_forward(q, k, v, causal=causal, interpret=interpret)[0], (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal=causal, interpret=interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, interpret, residuals, grad_out):
-    from hivemind_tpu.parallel.ring_attention import plain_attention
-
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda q, k, v: plain_attention(q, k, v, causal=causal), q, k, v)
-    return vjp(grad_out)
+    q, k, v, out, lse = residuals
+    # lse back to [bh, seq] layout happens inside _flash_backward; reshape here
+    # keeps residuals in the public [batch, seq, heads, dim] convention
+    lse_bhs = lse  # [batch, heads, seq] as returned by _flash_forward
+    return _flash_backward(
+        q, k, v, out, lse_bhs, grad_out.astype(q.dtype), causal=causal, interpret=interpret
+    )
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
@@ -167,12 +328,13 @@ flash_attention.defvjp(_flash_fwd, _flash_bwd)
 def _flash_enabled() -> bool:
     import os
 
-    return os.environ.get("HIVEMIND_TPU_FLASH_ATTENTION", "0") == "1"
+    return os.environ.get("HIVEMIND_TPU_FLASH_ATTENTION", "1") == "1"
 
 
 def attention_auto(q, k, v, mask=None, causal: bool = False):
     """Backend dispatch for the attention core: fused Pallas kernel on TPU (full
-    sequences, opt-in via HIVEMIND_TPU_FLASH_ATTENTION=1 until chip-validated),
+    sequences; both directions are fused kernels — set
+    HIVEMIND_TPU_FLASH_ATTENTION=0 to force the einsum core for A/B runs),
     reference einsum path elsewhere or when a padding mask is given."""
     # q_len != k_len (cached incremental decode) needs plain_attention's end-aligned
     # causal mask; the flash kernel assumes square self-attention

@@ -97,6 +97,7 @@ class Optimizer:
         state_averager_opts: Optional[dict] = None,
         tracker_opts: Optional[dict] = None,
         shutdown_timeout: float = 5.0,
+        chronic_failure_threshold: int = 5,
         verbose: bool = False,
     ):
         assert not (client_mode and auxiliary), "a peer is either a client or an auxiliary, not both"
@@ -127,6 +128,12 @@ class Optimizer:
             else None
         )
         self._pending_update: Optional[Future] = None
+        # chronic-degradation tracking: every epoch that ends without a successful
+        # swarm averaging round counts; after `chronic_failure_threshold` in a row
+        # the condition escalates to ERROR and matchmaking backs off exponentially
+        # (a persistently failing swarm must not silently train local SGD forever)
+        self.chronic_failure_threshold = chronic_failure_threshold
+        self._consecutive_failed_rounds = 0
 
         averager_common = dict(
             target_group_size=target_group_size,
@@ -256,12 +263,12 @@ class Optimizer:
                         self._pending_update = self._update_executor.submit(
                             self.state_averager.do_averaging_round,
                             timeout=self.averaging_timeout,
-                            scheduled_time=get_dht_time() + self.matchmaking_time,
+                            scheduled_time=get_dht_time() + self._matchmaking_delay(),
                         )
                 else:
                     self.state_averager.do_averaging_round(
                         timeout=self.averaging_timeout,
-                        scheduled_time=get_dht_time() + self.matchmaking_time,
+                        scheduled_time=get_dht_time() + self._matchmaking_delay(),
                     )
             self.tracker.update_epoch(self.local_epoch)
         return self.state_averager.params
@@ -273,7 +280,7 @@ class Optimizer:
             with contextlib.suppress(Exception):
                 self.grad_averager.step(
                     weight=0.0, timeout=self.averaging_timeout,
-                    scheduled_time=get_dht_time() + self.matchmaking_time,
+                    scheduled_time=get_dht_time() + self._matchmaking_delay(),
                 )
             self.tracker.update_epoch(self.tracker.global_epoch + 1)
 
@@ -283,6 +290,10 @@ class Optimizer:
         """Pre-schedule matchmaking so the group is ready the moment the swarm hits
         the target batch size (reference optimizer.py:559-567)."""
         assert self.grad_averager is not None
+        if self.chronic_averaging_failure:
+            # pre-scheduling re-declares in the DHT at full cadence every step; under
+            # chronic failure only the (backed-off) step-time path may matchmake
+            return
         eta = self.tracker.global_progress.eta_next_epoch - get_dht_time()
         if eta <= self.matchmaking_time * 2 and self._scheduled_control_invalid():
             scheduled_time = get_dht_time() + max(eta, 1e-2)
@@ -301,8 +312,9 @@ class Optimizer:
         assert self.grad_averager is not None and self.state_averager is not None
         next_epoch = max(self.local_epoch, self.tracker.global_epoch) + 1
 
-        averaged_ok = False
+        averaged_ok: Optional[bool] = None  # None = no round attempted (solo swarm)
         if self.tracker.global_progress.num_peers > 1:
+            averaged_ok = False
             control = None if self._scheduled_control_invalid() else self.scheduled_grads
             self.scheduled_grads = None
             try:
@@ -313,7 +325,7 @@ class Optimizer:
                     weight=self.grad_averager.local_samples_accumulated,
                     timeout=self.averaging_timeout,
                     reset_accumulators=False,
-                    scheduled_time=get_dht_time() + self.matchmaking_time if control is None else None,
+                    scheduled_time=get_dht_time() + self._matchmaking_delay() if control is None else None,
                 )
                 averaged_ok = True
             except Exception as e:
@@ -327,13 +339,53 @@ class Optimizer:
         self.grad_averager.reset_accumulated_grads_()
         self._finish_epoch_transition(next_epoch, averaged_ok)
 
-    def _finish_epoch_transition(self, next_epoch: int, averaged_ok: bool) -> None:
+    @property
+    def consecutive_failed_averaging_rounds(self) -> int:
+        """Epochs in a row that fell back to local gradients (0 = healthy)."""
+        return self._consecutive_failed_rounds
+
+    @property
+    def chronic_averaging_failure(self) -> bool:
+        """True once `chronic_failure_threshold` consecutive epochs degraded to
+        local SGD — the swarm is effectively unreachable for this peer."""
+        return self._consecutive_failed_rounds >= self.chronic_failure_threshold
+
+    def _record_round_outcome(self, averaged_ok: Optional[bool]) -> None:
+        if averaged_ok is None:
+            return  # no round was attempted (solo swarm): neither failure nor recovery
+        if averaged_ok:
+            if self.chronic_averaging_failure:
+                logger.info("swarm averaging recovered after "
+                            f"{self._consecutive_failed_rounds} failed epochs")
+            self._consecutive_failed_rounds = 0
+            return
+        self._consecutive_failed_rounds += 1
+        if self._consecutive_failed_rounds == self.chronic_failure_threshold:
+            logger.error(
+                f"{self._consecutive_failed_rounds} consecutive epochs degraded to local "
+                f"gradients — this peer is training local SGD, not collaborating; check "
+                f"connectivity/matchmaking (backing off matchmaking exponentially)"
+            )
+
+    def _matchmaking_delay(self) -> float:
+        """Matchmaking lead time, exponentially backed off under chronic failure
+        (cap 8×): a peer that cannot form groups should not hammer the DHT with
+        declare/fetch cycles at full cadence."""
+        excess = self._consecutive_failed_rounds - self.chronic_failure_threshold
+        if excess < 0:
+            return self.matchmaking_time
+        return self.matchmaking_time * min(2.0 ** (excess + 1), 8.0)
+
+    def _finish_epoch_transition(self, next_epoch: int, averaged_ok: Optional[bool]) -> None:
+        """``averaged_ok``: True/False for an attempted swarm round, None when no
+        round was attempted (num_peers <= 1 — a solo peer is healthy, not failing)."""
         assert self.state_averager is not None
+        self._record_round_outcome(averaged_ok)
         self.state_averager.local_epoch = next_epoch
         if self.average_state_every and next_epoch % self.average_state_every == 0 and self.tracker.global_progress.num_peers > 1:
             self.state_averager.do_averaging_round(
                 timeout=self.averaging_timeout,
-                scheduled_time=get_dht_time() + self.matchmaking_time,
+                scheduled_time=get_dht_time() + self._matchmaking_delay(),
             )
         self.state_averager.state_sharing_priority = next_epoch
         self.tracker.update_epoch(next_epoch)
@@ -371,15 +423,16 @@ class Optimizer:
 
     def _delayed_epoch_update(self, control, weight: float, next_epoch: int) -> None:
         assert self.grad_averager is not None and self.state_averager is not None
-        averaged_ok = False
+        averaged_ok: Optional[bool] = None  # None = no round attempted (solo swarm)
         if self.tracker.global_progress.num_peers > 1:
+            averaged_ok = False
             try:
                 self.grad_averager.step(
                     control=control,
                     weight=weight,
                     timeout=self.averaging_timeout,
                     load_accumulators=False,
-                    scheduled_time=get_dht_time() + self.matchmaking_time if control is None else None,
+                    scheduled_time=get_dht_time() + self._matchmaking_delay() if control is None else None,
                 )
                 averaged_ok = True
             except Exception as e:
@@ -396,7 +449,12 @@ class Optimizer:
         try:
             pending.result(timeout)
         except Exception as e:
-            logger.warning(f"background epoch transition failed: {e!r}")
+            # the whole background transition died (not just its averaging round):
+            # count it toward chronic degradation and escalate past the threshold
+            self._record_round_outcome(False)
+            log = logger.error if self.chronic_averaging_failure else logger.warning
+            log(f"background epoch transition failed "
+                f"({self._consecutive_failed_rounds} consecutive): {e!r}")
 
     def _should_load_state_from_peers(self) -> bool:
         """One-epoch grace (reference optimizer.py:655-673): a peer overlapping its

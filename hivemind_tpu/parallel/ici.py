@@ -4,17 +4,20 @@ logical swarm peer.
 The reference's hot loop reduces tensor parts with in-place host arithmetic on a single
 machine (reference hivemind/averaging/partition.py:242-260, ``add_``/``div_``). On TPU
 the intra-peer half of that reduction belongs ON the mesh: per-replica values are
-reduced with ``jax.lax.pmean`` (an ICI psum) under ``shard_map``, shards are assembled
-with XLA all-gathers by resharding to a replicated layout, and the host only ever
-stages the single already-reduced copy at the network boundary. The swarm (internet)
-tier then averages those host copies across peers; the result is scattered back onto
-the mesh as one ``device_put`` per leaf.
+reduced with ``jax.lax.pmean`` (an ICI psum) under ``shard_map``, then every leaf is
+assembled on the host SHARD BY SHARD — each distinct region is pulled from exactly one
+device with async DMAs and written straight into a preallocated mirror, so neither the
+device (no replicated resharding) nor the host (no transient second copy) ever holds
+more than one model copy plus one in-flight shard. The swarm (internet) tier then
+averages those host mirrors across peers; the result is scattered back onto the mesh
+one leaf at a time (each device receives only its shard).
 
 Two entry points:
 
 - :class:`MeshTensorBridge` — the device↔host boundary: ``mesh_mean`` (on-device psum
-  reduction over one mesh axis), ``gather_to_host`` (ICI all-gather → one fp32 host
-  copy per leaf), ``scatter_from_host`` (host → original shardings).
+  reduction over one mesh axis), ``stage_into_mirrors``/``gather_to_host`` (shard-wise
+  device→host assembly), ``scatter_leaf``/``scatter_from_host`` (host → original
+  shardings).
 - :class:`hivemind_tpu.averaging.ici.MeshAverager` — a DecentralizedAverager whose
   local tensors live sharded on a mesh and cross the host boundary only per round.
 """
@@ -93,35 +96,104 @@ class MeshTensorBridge:
 
     # ---------------------------------------------------------------- host boundary
 
-    def gather_to_host(self, tree: Any) -> List[np.ndarray]:
-        """Assemble full fp32 copies of every leaf on the host: XLA inserts the
-        all-gathers over ICI when resharding to a replicated layout; exactly one host
-        transfer happens per leaf, of the final reduced bytes."""
-        leaves, treedef = jax.tree_util.tree_flatten(tree)
-        key = ("gather", treedef, tuple((l.shape, str(l.dtype), str(_leaf_spec(l))) for l in leaves))
+    @staticmethod
+    def _unique_shards(leaf) -> list:
+        """The addressable shards covering the array once: replicated dims make
+        several devices hold identical shards — pull each distinct region from one
+        device only, so host traffic equals the array size, not the device count."""
+        seen, unique = set(), []
+        for shard in leaf.addressable_shards:
+            key = tuple((s.start, s.stop, s.step) for s in shard.index)
+            if key not in seen:
+                seen.add(key)
+                unique.append(shard)
+        return unique
+
+    def stage_into_mirrors(self, tree: Any, mirrors: Sequence[np.ndarray]) -> None:
+        """Assemble every leaf DIRECTLY into its preallocated host mirror, one
+        shard at a time: no on-device resharding (a replicated gather would cost a
+        full model replica of HBM **per device**) and no second host copy (peak
+        host memory = the mirrors + one in-flight shard). Leaf ``i+1``'s
+        device→host DMAs are started asynchronously while leaf ``i`` assembles, so
+        the transfer pipeline stays full. This is the device↔host analog of the
+        reference's 512 KiB part streaming (hivemind/averaging/partition.py:104-112);
+        here the natural chunk is the device shard."""
+        leaves, _ = jax.tree_util.tree_flatten(tree)
+        assert len(leaves) == len(mirrors), (len(leaves), len(mirrors))
+        if not all(getattr(leaf, "is_fully_addressable", True) for leaf in leaves):
+            # multi-process mesh: some shards live on other hosts' devices, so a
+            # shard pull cannot cover the mirror. Replicate ONE LEAF AT A TIME on
+            # device (transient HBM = one leaf per device, never a model copy) and
+            # read the now-local copy. See averaging/ici.py multi-host notes.
+            self._stage_with_per_leaf_replication(leaves, mirrors)
+            return
+        shard_lists = [self._unique_shards(leaf) for leaf in leaves]
+        for shard in shard_lists[0] if shard_lists else []:
+            shard.data.copy_to_host_async()
+        for index, (leaf, mirror) in enumerate(zip(leaves, mirrors)):
+            if index + 1 < len(leaves):
+                for shard in shard_lists[index + 1]:
+                    shard.data.copy_to_host_async()
+            out = mirror.reshape(leaf.shape)  # view (mirrors are C-contiguous)
+            if not shard_lists[index]:  # zero-size leaf
+                continue
+            for shard in shard_lists[index]:
+                out[shard.index] = np.asarray(shard.data).astype(out.dtype, copy=False)
+
+    def _stage_with_per_leaf_replication(self, leaves: Sequence[Any], mirrors: Sequence[np.ndarray]) -> None:
+        """Multi-host staging path: a collective (all processes must call this in
+        the same order) per-leaf replicate-and-read. Bounded: unlike the old
+        whole-tree replicated gather, at most one leaf is replicated at a time."""
+        replicated = NamedSharding(self.mesh, P())
+        key = ("replicate_one",)
         fn = self._fn_cache.get(key)
         if fn is None:
-            replicated = NamedSharding(self.mesh, P())
-            fn = jax.jit(
-                lambda ls: [x.astype(jnp.float32) for x in ls],
-                out_shardings=[replicated] * len(leaves),
+            fn = self._fn_cache[key] = jax.jit(
+                lambda x: x.astype(jnp.float32), out_shardings=replicated
             )
-            self._fn_cache[key] = fn
-        return [np.asarray(x) for x in fn(leaves)]
+        for leaf, mirror in zip(leaves, mirrors):
+            full = fn(leaf)
+            shard = next(iter(full.addressable_shards))  # replicated: any local device
+            mirror.reshape(leaf.shape)[...] = np.asarray(shard.data)
+            full.delete()  # free the replicated copy before the next leaf
+
+    def allocate_mirrors(self, tree: Any) -> List[np.ndarray]:
+        """Fresh fp32 host mirrors shaped like the tree's leaves."""
+        leaves, _ = jax.tree_util.tree_flatten(tree)
+        return [np.empty(leaf.shape, np.float32) for leaf in leaves]
+
+    def gather_to_host(self, tree: Any) -> List[np.ndarray]:
+        """Full fp32 host copies of every leaf, assembled shard-by-shard (see
+        ``stage_into_mirrors`` — no on-device replication happens)."""
+        mirrors = self.allocate_mirrors(tree)
+        self.stage_into_mirrors(tree, mirrors)
+        return mirrors
+
+    def scatter_leaf(self, like_leaf, host_value: np.ndarray, stack_axis_size: Optional[int] = None):
+        """Push ONE host value back to the mesh with ``like_leaf``'s sharding and
+        dtype. With ``stack_axis_size``, ``host_value`` is the reduced (unstacked)
+        value and every replica row adopts it via a broadcast VIEW — the stacked
+        array is never materialized on host."""
+        value = np.asarray(host_value, dtype=like_leaf.dtype)
+        if stack_axis_size is not None:
+            value = np.broadcast_to(
+                value.reshape(like_leaf.shape[1:]), tuple(like_leaf.shape)
+            )
+        else:
+            value = value.reshape(like_leaf.shape)
+        sharding = getattr(like_leaf, "sharding", None)
+        if isinstance(sharding, NamedSharding):
+            return jax.device_put(value, sharding)
+        return jnp.asarray(value)
 
     def scatter_from_host(self, like_tree: Any, host_tensors: Sequence[np.ndarray]) -> Any:
         """Push host values back onto the mesh with ``like_tree``'s shardings and
         dtypes (one device_put per leaf; each device receives only its shard)."""
         leaves, treedef = jax.tree_util.tree_flatten(like_tree)
         assert len(leaves) == len(host_tensors), (len(leaves), len(host_tensors))
-        new_leaves = []
-        for leaf, host in zip(leaves, host_tensors):
-            value = np.asarray(host, dtype=leaf.dtype).reshape(leaf.shape)
-            sharding = getattr(leaf, "sharding", None)
-            if isinstance(sharding, NamedSharding):
-                new_leaves.append(jax.device_put(value, sharding))
-            else:
-                new_leaves.append(jnp.asarray(value))
+        new_leaves = [
+            self.scatter_leaf(leaf, host) for leaf, host in zip(leaves, host_tensors)
+        ]
         return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
     def broadcast_scatter_from_host(

@@ -174,7 +174,12 @@ def mesh_attention_core(mesh, q, k, v, mask=None, causal: bool = False):
             inner = partial(ring_attention, axis_name="sp", causal=causal)
         core = shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **extra)
         return core(q, k, v)
-    return plain_attention(q, k, v, mask=mask, causal=causal)
+    # single-device: attention_auto picks the fused flash kernel on TPU (full,
+    # unmasked sequences) and the einsum core elsewhere — the flagship train step
+    # (mask=None via loss_masked_only) gets the kernel by default this way
+    from hivemind_tpu.ops.pallas_attention import attention_auto
+
+    return attention_auto(q, k, v, mask=mask, causal=causal)
 
 
 def plain_attention(

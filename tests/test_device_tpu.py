@@ -1,0 +1,26 @@
+"""On-device (TPU) kernel validation — the manual-run twin of the checks
+`bench.py` embeds in the round artifact whenever the chip answers.
+
+On CPU runners this exercises the same code in interpret mode (cheap smoke);
+on a real TPU it validates Mosaic-compiled kernels. Run on hardware with:
+``python -m pytest tests/test_device_tpu.py -q`` after unsetting the CPU pin.
+"""
+
+import jax
+
+
+def test_validate_on_device_report():
+    from hivemind_tpu.ops.device_check import validate_on_device
+
+    report = validate_on_device(seq=256)
+    assert report["backend"] == jax.default_backend()
+    expected = {
+        "flash_fwd_bidir", "flash_fwd_causal", "flash_bwd_bidir", "flash_bwd_causal",
+        "blockwise_int8_roundtrip",
+    }
+    assert expected <= set(report["checks"]) | set(report["errors"]), report
+    assert report["ok"], report
+    assert report["attention_ok"], report
+    for name, err in report["checks"].items():
+        if name.startswith("flash"):
+            assert err < 2e-2, (name, err)

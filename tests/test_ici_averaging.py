@@ -42,6 +42,50 @@ def test_bridge_gather_scatter_roundtrip():
     assert new_tree["a"].sharding.spec == P("dp", "tp")
 
 
+def test_staging_runs_no_xla_and_pulls_each_region_once():
+    """VERDICT r2 weak #3 regression: host staging must be pure shard pulls — no
+    jit/XLA computation (the old replicated-gather cost a full model replica of
+    device memory PER DEVICE), and each distinct region must be fetched from
+    exactly one device even when the sharding replicates it across many."""
+    mesh = make_mesh(dp=2, tp=2, sp=2)
+    bridge = MeshTensorBridge(mesh)
+    rng = np.random.RandomState(2)
+    host = {
+        "sharded": rng.randn(8, 16).astype(np.float32),
+        "replicated": rng.randn(5, 3).astype(np.float32),  # every device holds it all
+        "mixed": rng.randn(4, 6).astype(np.float32),  # sharded over dp, replicated over tp/sp
+    }
+    tree = {
+        "sharded": jax.device_put(host["sharded"], NamedSharding(mesh, P("dp", "tp"))),
+        "replicated": jax.device_put(host["replicated"], NamedSharding(mesh, P())),
+        "mixed": jax.device_put(host["mixed"], NamedSharding(mesh, P("dp", None))),
+    }
+
+    # every distinct region exactly once: 4 for P(dp, tp), 1 for replicated, 2 for P(dp)
+    assert len(bridge._unique_shards(tree["sharded"])) == 4
+    assert len(bridge._unique_shards(tree["replicated"])) == 1
+    assert len(bridge._unique_shards(tree["mixed"])) == 2
+
+    import unittest.mock
+
+    mirrors = bridge.allocate_mirrors(tree)
+    with unittest.mock.patch.object(
+        jax, "jit", side_effect=AssertionError("staging must not launch XLA computations")
+    ):
+        bridge.stage_into_mirrors(tree, mirrors)
+    flat_host = [jax.tree_util.tree_flatten(host)[0][i] for i in range(3)]
+    for got, expected in zip(mirrors, flat_host):
+        np.testing.assert_array_equal(got, expected)
+
+    # bf16 leaves are upcast into the fp32 mirrors shard-by-shard
+    bf16 = jax.device_put(
+        jnp.asarray(host["sharded"], jnp.bfloat16), NamedSharding(mesh, P("dp", None))
+    )
+    [mirror] = bridge.gather_to_host([bf16])
+    assert mirror.dtype == np.float32
+    np.testing.assert_allclose(mirror, host["sharded"], atol=0.01, rtol=0.01)
+
+
 def test_bridge_mesh_mean_is_psum_mean():
     """Per-replica stacks reduce on-device (pmean under shard_map) to the numpy mean."""
     mesh = make_mesh(dp=4, tp=2)

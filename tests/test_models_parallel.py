@@ -313,3 +313,30 @@ def test_pallas_flash_attention_matches_plain():
         np.asarray(jax.grad(loss_fused)(q)), np.asarray(jax.grad(loss_exact)(q)),
         rtol=2e-5, atol=2e-5,
     )
+
+
+def test_pallas_flash_backward_kernels_match_plain_grads():
+    """The FUSED two-pass backward (dQ / dK+dV kernels from the saved lse) must
+    reproduce the einsum path's gradients for all inputs — bidirectional and
+    causal, block-aligned and padded, with a non-uniform cotangent so dP/delta
+    terms are actually exercised (VERDICT r2 item 7)."""
+    import numpy as np
+    from hivemind_tpu.ops.pallas_attention import flash_attention
+    from hivemind_tpu.parallel.ring_attention import plain_attention
+
+    rng = np.random.RandomState(1)
+    w = jnp.asarray(np.cos(np.arange(16)), jnp.float32)  # non-uniform cotangent
+    for causal in (False, True):
+        for seq in (128, 200):
+            q, k, v = (
+                jnp.asarray(rng.randn(2, seq, 4, 16).astype(np.float32)) for _ in range(3)
+            )
+            loss_fused = lambda q, k, v: (flash_attention(q, k, v, causal, True) * w).sum()
+            loss_exact = lambda q, k, v: (plain_attention(q, k, v, causal=causal) * w).sum()
+            grads_fused = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+            grads_exact = jax.grad(loss_exact, argnums=(0, 1, 2))(q, k, v)
+            for name, gf, ge in zip("qkv", grads_fused, grads_exact):
+                np.testing.assert_allclose(
+                    np.asarray(gf), np.asarray(ge), rtol=2e-4, atol=2e-5,
+                    err_msg=f"d{name} causal={causal} seq={seq}",
+                )
