@@ -11,3 +11,4 @@ from hivemind_tpu.averaging.partition import (
     TensorPartContainer,
     TensorPartReducer,
 )
+from hivemind_tpu.averaging.slice import SliceAverager

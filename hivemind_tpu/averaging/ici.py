@@ -53,10 +53,15 @@ class MeshAverager(DecentralizedAverager):
         dht: DHT,
         *,
         local_reduce_axis: Optional[str] = None,
+        external_staging: bool = False,
         **kwargs,
     ):
         self.bridge = MeshTensorBridge(mesh)
         self.local_reduce_axis = local_reduce_axis
+        # multi-host slices (averaging/slice.py): staging/scatter are COLLECTIVE
+        # jax operations that every process must join, so SliceAverager drives
+        # them at synchronized points instead of the round's async hooks
+        self.external_staging = external_staging
         self._device_tree = device_tree
         self._tree_lock = threading.Lock()
         # one mesh = one logical peer, so its advertised bandwidth to the LP load
@@ -119,7 +124,9 @@ class MeshAverager(DecentralizedAverager):
             self._device_tree = jax.tree_util.tree_unflatten(treedef, new_leaves)
 
     async def _pre_allreduce(self) -> None:
-        await asyncio.get_event_loop().run_in_executor(None, self._stage_to_host)
+        if not self.external_staging:
+            await asyncio.get_event_loop().run_in_executor(None, self._stage_to_host)
 
     async def _post_allreduce(self) -> None:
-        await asyncio.get_event_loop().run_in_executor(None, self._scatter_to_mesh)
+        if not self.external_staging:
+            await asyncio.get_event_loop().run_in_executor(None, self._scatter_to_mesh)

@@ -182,9 +182,20 @@ class MeshTensorBridge:
         else:
             value = value.reshape(like_leaf.shape)
         sharding = getattr(like_leaf, "sharding", None)
-        if isinstance(sharding, NamedSharding):
+        if not isinstance(sharding, NamedSharding):
+            return jnp.asarray(value)
+        if getattr(like_leaf, "is_fully_addressable", True):
             return jax.device_put(value, sharding)
-        return jnp.asarray(value)
+        # multi-process mesh: device_put cannot target other hosts' devices. Every
+        # process holds the SAME host value (guaranteed by the slice protocol's
+        # broadcast); each one uploads its local shards and the global array is
+        # assembled from them (the documented multi-host construction path).
+        index_map = sharding.addressable_devices_indices_map(tuple(value.shape))
+        locals_ = [
+            jax.device_put(np.ascontiguousarray(value[index]), device)
+            for device, index in index_map.items()
+        ]
+        return jax.make_array_from_single_device_arrays(tuple(value.shape), sharding, locals_)
 
     def scatter_from_host(self, like_tree: Any, host_tensors: Sequence[np.ndarray]) -> Any:
         """Push host values back onto the mesh with ``like_tree``'s shardings and
