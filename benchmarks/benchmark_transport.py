@@ -1,7 +1,19 @@
-"""Internet-tier transport throughput: encrypted mux stream MB/s between two peers
-over localhost TCP (the measured justification that the Python asyncio + Noise-AEAD
-data path saturates internet-grade links; the ICI tier handles intra-pod bandwidth —
-see docs/design_notes.md and SURVEY §5 two-tier backend)."""
+"""Internet-tier transport throughput: encrypted mux stream MB/s between peers over
+localhost TCP (the measured justification that the asyncio + Noise-AEAD data path
+saturates internet-grade links; the ICI tier handles intra-pod bandwidth — see
+docs/design_notes.md and SURVEY §5 two-tier backend).
+
+Modes:
+  default            one in-process peer pair, one stream (the historical number)
+  --streams k        one pair, k concurrent streams (mux + pipelined AEAD overlap)
+  --procs k          one server process + k client processes, each its own stream;
+                     prints the AGGREGATE rate. This is the multi-core data-plane
+                     measurement (VERDICT r2 #5): with HIVEMIND_AEAD_THREADS > 0 the
+                     server unseals the k streams on the AEAD worker pool, so on an
+                     m-core host the aggregate scales with min(k, m) until the event
+                     loop (framing + protobuf) saturates one core.
+  --relay            route the stream through the native C++ relay daemon's splice
+"""
 
 import os
 import sys
@@ -11,31 +23,20 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))) 
 import argparse
 import asyncio
 import json
+import subprocess
 import time
 
 import numpy as np
 
 
-async def run(args):
-    from hivemind_tpu.p2p import P2P, P2PContext
+def _payload_mb(mbytes: int) -> np.ndarray:
+    return np.random.RandomState(0).randn(mbytes * 1024 * 1024 // 4).astype(np.float32)
+
+
+async def _add_sink(server):
+    from hivemind_tpu.p2p import P2PContext
     from hivemind_tpu.proto import runtime_pb2
-    from hivemind_tpu.compression import serialize_tensor, split_tensor_for_streaming
 
-    relay_proc = None
-    if args.relay:
-        # route the stream through the native relay daemon (splice data path)
-        import subprocess
-
-        native = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                              "hivemind_tpu", "native")
-        subprocess.run(["make"], cwd=native, check=True, capture_output=True)
-        relay_proc = subprocess.Popen(
-            [os.path.join(native, "relay_daemon"), "0"], stdout=subprocess.PIPE, text=True
-        )
-        relay_port = int(relay_proc.stdout.readline().strip().rsplit(" ", 1)[-1])
-
-    server = await P2P.create()
-    client = await P2P.create()
     received = []
 
     async def sink(requests, context: P2PContext):
@@ -49,6 +50,44 @@ async def run(args):
     await server.add_protobuf_handler(
         "sink", sink, runtime_pb2.ExpertRequest, stream_input=True, stream_output=True
     )
+    return received
+
+
+async def _stream_once(client, server_peer_id, serialized, chunk_bytes: int) -> float:
+    from hivemind_tpu.proto import runtime_pb2
+    from hivemind_tpu.compression import split_tensor_for_streaming
+
+    async def requests():
+        for chunk in split_tensor_for_streaming(serialized, chunk_bytes):
+            yield runtime_pb2.ExpertRequest(uid="bench", tensors=[chunk])
+
+    start = time.perf_counter()
+    async for _response in client.iterate_protobuf_handler(
+        server_peer_id, "sink", requests(), runtime_pb2.ExpertResponse
+    ):
+        pass
+    return time.perf_counter() - start
+
+
+async def run_pair(args):
+    from hivemind_tpu.p2p import P2P
+    from hivemind_tpu.compression import serialize_tensor
+
+    relay_proc = None
+    if args.relay:
+        # route the stream through the native relay daemon (splice data path)
+        native = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                              "hivemind_tpu", "native")
+        subprocess.run(["make"], cwd=native, check=True, capture_output=True)
+        relay_proc = subprocess.Popen(
+            [os.path.join(native, "relay_daemon"), "0"], stdout=subprocess.PIPE, text=True
+        )
+        relay_port = int(relay_proc.stdout.readline().strip().rsplit(" ", 1)[-1])
+
+    server = await P2P.create()
+    client = await P2P.create()
+    received = await _add_sink(server)
+
     if args.relay:
         from hivemind_tpu.p2p.relay import RelayClient
 
@@ -57,27 +96,23 @@ async def run(args):
     else:
         await client.connect(server.get_visible_maddrs()[0])
 
-    payload = np.random.RandomState(0).randn(args.mbytes * 1024 * 1024 // 4).astype(np.float32)
-    serialized = serialize_tensor(payload)
-
-    async def requests():
-        for chunk in split_tensor_for_streaming(serialized, 2**20):
-            yield runtime_pb2.ExpertRequest(uid="bench", tensors=[chunk])
-
+    serialized = serialize_tensor(_payload_mb(args.mbytes))
     start = time.perf_counter()
-    async for _response in client.iterate_protobuf_handler(
-        server.peer_id, "sink", requests(), runtime_pb2.ExpertResponse
-    ):
-        pass
+    await asyncio.gather(*(
+        _stream_once(client, server.peer_id, serialized, args.chunk_kb * 1024)
+        for _ in range(args.streams)
+    ))
     elapsed = time.perf_counter() - start
 
-    mb = received[0] / 1e6
+    mb = sum(received) / 1e6
     print(json.dumps({
         "metric": "transport_stream_throughput",
         "value": round(mb / elapsed, 1),
         "unit": "MB/s",
         "extra": {
             "payload_mb": round(mb, 1), "seconds": round(elapsed, 3),
+            "streams": args.streams,
+            "aead_threads": os.environ.get("HIVEMIND_AEAD_THREADS", "auto"),
             "path": ("relay splice + noise AEAD + mux, localhost" if args.relay
                      else "tcp + noise AEAD + mux, localhost"),
         },
@@ -89,13 +124,105 @@ async def run(args):
         relay_proc.wait()
 
 
+async def run_server_role(args):
+    from hivemind_tpu.p2p import P2P
+
+    server = await P2P.create()
+    await _add_sink(server)
+    print(str(server.get_visible_maddrs()[0]), flush=True)
+    await asyncio.get_running_loop().run_in_executor(None, sys.stdin.read)  # until parent closes us
+    await server.shutdown()
+
+
+async def run_client_role(args):
+    from hivemind_tpu.p2p import P2P
+    from hivemind_tpu.compression import serialize_tensor
+    from hivemind_tpu.p2p.peer_id import Multiaddr
+
+    maddr = Multiaddr.parse(args.server_maddr)
+    client = await P2P.create()
+    await client.connect(maddr)
+    serialized = serialize_tensor(_payload_mb(args.mbytes))
+    sys.stdout.write("READY\n")
+    sys.stdout.flush()
+    sys.stdin.readline()  # start barrier: parent releases all clients at once
+    elapsed = await _stream_once(client, maddr.peer_id, serialized, args.chunk_kb * 1024)
+    print(json.dumps({"seconds": elapsed, "mb": args.mbytes * 1.048576}), flush=True)
+    await client.shutdown()
+
+
+def run_multiproc(args):
+    """One server process, k client processes, aggregate MB/s over the joint window."""
+    here = os.path.abspath(__file__)
+    server = subprocess.Popen(
+        [sys.executable, here, "--role", "server"],
+        stdout=subprocess.PIPE, stdin=subprocess.PIPE, text=True,
+    )
+    try:
+        maddr = server.stdout.readline().strip()
+        assert maddr, "server process failed to start"
+        clients = [
+            subprocess.Popen(
+                [sys.executable, here, "--role", "client", "--server-maddr", maddr,
+                 "--mbytes", str(args.mbytes), "--chunk-kb", str(args.chunk_kb)],
+                stdout=subprocess.PIPE, stdin=subprocess.PIPE, text=True,
+            )
+            for _ in range(args.procs)
+        ]
+        for client in clients:
+            assert client.stdout.readline().strip() == "READY"
+        start = time.perf_counter()
+        for client in clients:
+            client.stdin.write("go\n")
+            client.stdin.flush()
+        results = [json.loads(client.stdout.readline()) for client in clients]
+        wall = time.perf_counter() - start
+        for client in clients:
+            client.wait(timeout=30)
+        total_mb = sum(r["mb"] for r in results)
+        print(json.dumps({
+            "metric": "transport_aggregate_throughput",
+            "value": round(total_mb / wall, 1),
+            "unit": "MB/s",
+            "extra": {
+                "client_procs": args.procs, "payload_mb_total": round(total_mb, 1),
+                "wall_seconds": round(wall, 3),
+                "per_client_mbps": [round(r["mb"] / r["seconds"], 1) for r in results],
+                "aead_threads": os.environ.get("HIVEMIND_AEAD_THREADS", "auto"),
+                "host_cores": os.cpu_count(),
+                "path": "tcp + noise AEAD + mux, localhost, 1 server proc",
+            },
+        }))
+    finally:
+        server.stdin.close()
+        try:
+            server.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            server.kill()
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--mbytes", type=int, default=256)
+    parser.add_argument("--chunk-kb", type=int, default=2048,
+                        help="streaming part size (clamped to the mux message cap)")
+    parser.add_argument("--streams", type=int, default=1,
+                        help="concurrent streams over one connection (in-process mode)")
+    parser.add_argument("--procs", type=int, default=0,
+                        help="client processes against one server process (aggregate mode)")
     parser.add_argument("--relay", action="store_true",
                         help="route through the native relay daemon (circuit splice)")
+    parser.add_argument("--role", choices=["server", "client"], help=argparse.SUPPRESS)
+    parser.add_argument("--server-maddr", help=argparse.SUPPRESS)
     args = parser.parse_args()
-    asyncio.run(run(args))
+    if args.role == "server":
+        asyncio.run(run_server_role(args))
+    elif args.role == "client":
+        asyncio.run(run_client_role(args))
+    elif args.procs > 0:
+        run_multiproc(args)
+    else:
+        asyncio.run(run_pair(args))
 
 
 if __name__ == "__main__":

@@ -12,12 +12,28 @@ XX-pattern handshake implemented with the ``cryptography`` primitives:
    the 4-byte length header as associated data.
 
 Frame wire format: [u32 big-endian ciphertext length][ciphertext].
+
+Data-plane parallelism: the reference's Go daemon spreads AEAD + IO over goroutines
+(p2p_daemon.py:84-147 delegates the whole data path); a single asyncio thread doing
+AEAD in-line caps the cross-pod tier at one core. Both directions are therefore
+PIPELINED: ``send`` assigns the nonce and enqueues the seal, a writer task emits
+ciphertexts strictly in nonce order; the reader task prefetches and unseals ahead of
+``recv``. Frames above ``_OFFLOAD_THRESHOLD`` are sealed/opened in a shared thread
+pool — ChaCha20-Poly1305 releases the GIL in OpenSSL, so on a multi-core host k
+connections (or k queued frames of one connection) use k cores. On a single-core
+host the pool is disabled (``HIVEMIND_AEAD_THREADS=0`` forces this; any other value
+overrides the default ``min(4, cpu_count)``) and the pipeline still batches socket
+writes. In-flight frames are bounded both ways (send semaphore / bounded prefetch
+queue), so memory stays capped and TCP backpressure propagates to callers.
 """
 
 from __future__ import annotations
 
 import asyncio
+import contextlib
+import os
 import struct
+from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Tuple
 
 from cryptography.exceptions import InvalidTag
@@ -31,6 +47,34 @@ from hivemind_tpu.utils.serializer import MSGPackSerializer
 
 MAX_FRAME_SIZE = 16 * 1024 * 1024  # hard cap on one encrypted frame
 _HANDSHAKE_PREFIX = b"hivemind-tpu-noise-v1:"
+
+# frames at least this large have their AEAD offloaded to the worker pool; smaller
+# ones are sealed inline (executor hop costs more than the cipher call)
+_OFFLOAD_THRESHOLD = 128 * 1024
+_MAX_INFLIGHT_SEND = 16  # per channel; bounds sender memory at 16 frames
+_RECV_PREFETCH = 8  # frames unsealed ahead of recv(); bounds receiver memory
+
+_aead_executor: Optional[ThreadPoolExecutor] = None
+
+
+def _aead_workers() -> int:
+    configured = os.environ.get("HIVEMIND_AEAD_THREADS")
+    if configured is not None:
+        return max(0, int(configured))
+    count = os.cpu_count() or 1
+    return min(4, count) if count > 1 else 0
+
+
+def _get_aead_executor() -> Optional[ThreadPoolExecutor]:
+    global _aead_executor
+    workers = _aead_workers()
+    if workers <= 0:
+        return None
+    if _aead_executor is None or _aead_executor._max_workers != workers:
+        if _aead_executor is not None:
+            _aead_executor.shutdown(wait=False)
+        _aead_executor = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="hm_aead")
+    return _aead_executor
 
 
 class HandshakeError(RuntimeError):
@@ -56,35 +100,147 @@ class SecureChannel:
         self._send_counter = 0
         self._recv_counter = 0
         self.peer_public_key = peer_public_key
-        self._send_lock = asyncio.Lock()
+        # ordered pipelines (see module docstring); tasks start lazily so a channel
+        # that fails mid-handshake never spawns them without a closer
+        self._send_queue: asyncio.Queue = asyncio.Queue()
+        self._send_sem = asyncio.Semaphore(_MAX_INFLIGHT_SEND)
+        self._send_error: Optional[BaseException] = None
+        self._writer_task: Optional[asyncio.Task] = None
+        self._recv_queue: asyncio.Queue = asyncio.Queue(maxsize=_RECV_PREFETCH)
+        self._recv_error: Optional[BaseException] = None
+        self._reader_task: Optional[asyncio.Task] = None
+        self._closed = False
+
+    # ------------------------------------------------------------------ send side
 
     async def send(self, payload: bytes) -> None:
+        if self._send_error is not None:
+            raise self._send_failed()
         # size check BEFORE the counter moves: raising after an increment would
         # desynchronize AEAD nonces and poison the whole connection
         if len(payload) + 16 > MAX_FRAME_SIZE:  # +16: poly1305 tag
             raise ValueError(f"frame too large: {len(payload)} > {MAX_FRAME_SIZE - 16}")
-        async with self._send_lock:
-            nonce = struct.pack("<4xQ", self._send_counter)
-            self._send_counter += 1
-            ciphertext = self._send_aead.encrypt(nonce, payload, None)
-            header = struct.pack(">I", len(ciphertext))
-            self._writer.write(header + ciphertext)
-            await self._writer.drain()
+        await self._send_sem.acquire()
+        if self._send_error is not None:
+            self._send_sem.release()
+            raise self._send_failed()
+        # no await between the counter assignment and the enqueue: nonce order and
+        # wire order are decided atomically on the event loop
+        nonce = struct.pack("<4xQ", self._send_counter)
+        self._send_counter += 1
+        executor = _get_aead_executor()
+        if executor is not None and len(payload) >= _OFFLOAD_THRESHOLD:
+            sealed = asyncio.get_running_loop().run_in_executor(
+                executor, self._send_aead.encrypt, nonce, payload, None
+            )
+        else:
+            sealed = self._send_aead.encrypt(nonce, payload, None)
+        if self._writer_task is None:
+            self._writer_task = asyncio.create_task(self._writer_loop())
+        self._send_queue.put_nowait(sealed)
+
+    def _send_failed(self) -> ConnectionError:
+        error = self._send_error
+        if isinstance(error, (ConnectionError, OSError)):
+            return error  # type: ignore[return-value]
+        return ConnectionError(f"secure channel send failed: {error!r}")
+
+    def _fail_send(self, error: BaseException) -> None:
+        if self._send_error is None:
+            self._send_error = error
+        # wake every sender parked on the in-flight semaphore
+        for _ in range(_MAX_INFLIGHT_SEND):
+            self._send_sem.release()
+
+    async def _writer_loop(self) -> None:
+        try:
+            while True:
+                sealed = await self._send_queue.get()
+                if sealed is None:
+                    return
+                ciphertext = (await sealed) if asyncio.isfuture(sealed) else sealed
+                header = struct.pack(">I", len(ciphertext))
+                if len(ciphertext) >= _OFFLOAD_THRESHOLD:
+                    # two writes skip the megabyte-scale header+body concat copy
+                    self._writer.write(header)
+                    self._writer.write(ciphertext)
+                else:
+                    self._writer.write(header + ciphertext)
+                self._send_sem.release()
+                # drain() is a no-op below the transport high-water mark; above it,
+                # this is where TCP backpressure propagates: writer blocks → queue
+                # fills → the in-flight semaphore parks the senders
+                await self._writer.drain()
+        except asyncio.CancelledError:
+            raise
+        except BaseException as e:
+            self._fail_send(e)
+
+    # ------------------------------------------------------------------ recv side
 
     async def recv(self) -> bytes:
-        header = await self._reader.readexactly(4)
-        (length,) = struct.unpack(">I", header)
-        if length > MAX_FRAME_SIZE:
-            raise HandshakeError(f"oversized frame: {length}")
-        ciphertext = await self._reader.readexactly(length)
-        nonce = struct.pack("<4xQ", self._recv_counter)
-        self._recv_counter += 1
+        if self._reader_task is None:
+            self._reader_task = asyncio.create_task(self._reader_loop())
+        while True:
+            if self._recv_error is not None and self._recv_queue.empty():
+                raise self._recv_error
+            opened = await self._recv_queue.get()
+            if opened is None:  # reader loop ended; the stored error says why
+                continue
+            try:
+                return (await opened) if asyncio.isfuture(opened) else opened
+            except InvalidTag:
+                raise HandshakeError("AEAD authentication failed (corrupted or replayed frame)")
+
+    async def _reader_loop(self) -> None:
+        error: BaseException
         try:
-            return self._recv_aead.decrypt(nonce, ciphertext, None)
-        except InvalidTag:
-            raise HandshakeError("AEAD authentication failed (corrupted or replayed frame)")
+            while True:
+                header = await self._reader.readexactly(4)
+                (length,) = struct.unpack(">I", header)
+                if length > MAX_FRAME_SIZE:
+                    raise HandshakeError(f"oversized frame: {length}")
+                ciphertext = await self._reader.readexactly(length)
+                nonce = struct.pack("<4xQ", self._recv_counter)
+                self._recv_counter += 1
+                executor = _get_aead_executor()
+                if executor is not None and length >= _OFFLOAD_THRESHOLD:
+                    opened = asyncio.get_running_loop().run_in_executor(
+                        executor, self._recv_aead.decrypt, nonce, ciphertext, None
+                    )
+                else:
+                    try:
+                        opened = self._recv_aead.decrypt(nonce, ciphertext, None)
+                    except InvalidTag:
+                        raise HandshakeError(
+                            "AEAD authentication failed (corrupted or replayed frame)"
+                        )
+                await self._recv_queue.put(opened)  # bounded: backpressures the socket
+        except asyncio.CancelledError:
+            raise
+        except BaseException as e:
+            error = e
+        self._recv_error = error
+        # a dead connection must also stop the writer (it may be parked on its queue)
+        self._fail_send(error)
+        if self._writer_task is not None:
+            self._send_queue.put_nowait(None)
+        await self._recv_queue.put(None)  # wake a parked recv()
+
+    # ------------------------------------------------------------------ lifecycle
 
     def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._fail_send(ConnectionError("secure channel closed"))
+        if self._recv_error is None:
+            self._recv_error = ConnectionError("secure channel closed")
+        for task in (self._writer_task, self._reader_task):
+            if task is not None:
+                task.cancel()
+        with contextlib.suppress(Exception):
+            self._recv_queue.put_nowait(None)  # wake a parked recv()
         try:
             self._writer.close()
         except Exception:
@@ -158,9 +314,13 @@ async def handshake(
         # key confirmation: proves the peer holds the ephemeral private key, which a
         # replayed hello cannot (helloes alone are replayable — sig covers only the
         # static prefix + own ephemeral). Both sides send first, then verify.
-        await channel.send(b"confirm")
-        if await channel.recv() != b"confirm":
-            raise HandshakeError("peer failed key confirmation")
+        try:
+            await channel.send(b"confirm")
+            if await channel.recv() != b"confirm":
+                raise HandshakeError("peer failed key confirmation")
+        except BaseException:
+            channel.close()  # reap the pipeline tasks the confirm exchange started
+            raise
         return channel, {"addrs": peer_hello.get("addrs", []), "static": peer_hello["static"]}
 
     return await asyncio.wait_for(_run(), timeout=timeout)
