@@ -8,6 +8,7 @@ from hivemind_tpu.p2p.p2p import (
     P2PHandlerError,
     PeerNotFoundError,
 )
+from hivemind_tpu.p2p.autorelay import AutoRelay, advertise_relay
 from hivemind_tpu.p2p.nat import NATTraversal
 from hivemind_tpu.p2p.peer_id import Multiaddr, PeerID
 from hivemind_tpu.p2p.servicer import ServicerBase, StubBase

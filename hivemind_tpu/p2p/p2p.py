@@ -108,6 +108,7 @@ class P2P:
         identity: Optional[Ed25519PrivateKey] = None,
         identity_path: Optional[str] = None,
         announce_host: Optional[str] = None,
+        announce_port: Optional[int] = None,
         initial_peers: Sequence[Union[str, Multiaddr]] = (),
         dial_timeout: float = 10.0,
         relays: Sequence[str] = (),
@@ -135,9 +136,14 @@ class P2P:
         self._dial_timeout = dial_timeout
         self._bg_tasks: Set[asyncio.Task] = set()  # strong refs: loop holds tasks weakly
         self._alive_refs = 1  # P2P.replicate parity: shared instance refcount
+        self._peer_resolver = None  # optional async fallback route lookup (auto-relay)
+        self._shutting_down = False
         self._relays: list = []  # RelayClients registered via the `relays` kwarg
         self._listen_host = listen_host
         self._announce_host = announce_host or listen_host
+        # NATed/port-forwarded deployments: the externally visible port can differ
+        # from the bound one (or be closed entirely — AutoNAT then diagnoses it)
+        self._announce_port = announce_port
 
         self._server = None
         try:
@@ -251,7 +257,8 @@ class P2P:
         return self
 
     def get_visible_maddrs(self, latest: bool = False) -> List[Multiaddr]:
-        return [Multiaddr(self._announce_host, self._listen_port, self.peer_id)]
+        port = self._announce_port if self._announce_port is not None else self._listen_port
+        return [Multiaddr(self._announce_host, port, self.peer_id)]
 
     @property
     def listen_port(self) -> int:
@@ -260,6 +267,9 @@ class P2P:
     # ------------------------------------------------------------------ connections
 
     async def _on_inbound_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        if self._shutting_down:
+            writer.close()
+            return
         try:
             channel, extras = await handshake(
                 reader, writer, self.identity, is_initiator=False,
@@ -272,6 +282,11 @@ class P2P:
         from hivemind_tpu.utils.crypto import Ed25519PublicKey
 
         peer_id = PeerID.from_public_key(Ed25519PublicKey.from_bytes(extras["static"]))
+        if self._shutting_down:
+            # a dial (e.g. a hole punch) that completed its handshake mid-shutdown:
+            # an untracked live connection here would park Server.wait_closed forever
+            channel.close()
+            return
         self._register_peer_addrs(peer_id, extras.get("addrs", ()))
         self._prune_dead_connections()
         conn = MuxConnection(channel, peer_id, is_initiator=False, on_inbound_stream=self._route_stream)
@@ -375,7 +390,23 @@ class P2P:
                     return await self._dial(maddr, expected_peer=peer_id)
                 except Exception as e:
                     last_error = e
+            if self._peer_resolver is not None:
+                # no direct route: ask the installed resolver (auto-relay finds the
+                # target's published circuits in the DHT and dials through a relay)
+                try:
+                    conn = await self._peer_resolver(peer_id)
+                except Exception as e:
+                    conn = None
+                    last_error = e
+                if conn is not None and not conn.is_closed:
+                    return conn
             raise PeerNotFoundError(f"no reachable address for {peer_id}") from last_error
+
+    def set_peer_resolver(self, resolver) -> None:
+        """Install an async ``fn(peer_id) -> Optional[MuxConnection]`` used when no
+        direct address works (reference analog: the daemon's peer routing + relays,
+        p2p_daemon.py:114-137). Pass None to remove."""
+        self._peer_resolver = resolver
 
     # ------------------------------------------------------------------ handlers
 
@@ -521,18 +552,24 @@ class P2P:
         self._alive_refs -= 1
         if self._alive_refs > 0:
             return
+        self._shutting_down = True
         self._server.close()
         for relay in self._relays:
             await relay.close()
         self._relays.clear()
         for task in list(self._bg_tasks):
             task.cancel()
-        for conn in list(self._all_connections):
-            await conn.close()
-        self._all_connections.clear()
+        # loop until drained: a connection may land (accepted before server.close,
+        # e.g. a peer's hole-punch dial) while earlier closes are awaited
+        while self._all_connections:
+            for conn in list(self._all_connections):
+                await conn.close()
+                self._all_connections.discard(conn)
         self._connections.clear()
         try:
-            await self._server.wait_closed()
+            # py3.12 wait_closed waits for every server-spawned transport; a peer
+            # whose handshake is still mid-flight holds one open, so bound the wait
+            await asyncio.wait_for(self._server.wait_closed(), timeout=3.0)
         except Exception:
             pass
         if self._identity_lock_fd is not None:

@@ -1,0 +1,111 @@
+"""Auto-relay via the DHT (VERDICT r2 next-round #6; reference use_auto_relay,
+hivemind/p2p/p2p_daemon.py:114-137): a NATed peer with ZERO relay configuration
+diagnoses itself via AutoNAT dial-back, discovers an advertised relay in the DHT,
+registers there, publishes its circuits — and a public peer dials it purely by
+peer id through the installed resolver."""
+
+import asyncio
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from hivemind_tpu.dht import DHT
+from hivemind_tpu.p2p import P2P, AutoRelay, P2PContext, advertise_relay
+from hivemind_tpu.p2p.autorelay import RELAY_DHT_KEY, RELAYED_PEER_PREFIX
+from hivemind_tpu.proto import test_pb2
+
+NATIVE_DIR = Path(__file__).parent.parent / "hivemind_tpu" / "native"
+RELAY_BIN = NATIVE_DIR / "relay_daemon"
+
+
+@pytest.fixture(scope="module")
+def relay_daemon():
+    if not RELAY_BIN.exists():
+        subprocess.run(["make"], cwd=NATIVE_DIR, check=True, capture_output=True)
+    proc = subprocess.Popen([str(RELAY_BIN), "0"], stdout=subprocess.PIPE, text=True)
+    port = int(proc.stdout.readline().strip().rsplit(" ", 1)[-1])
+    identity_line = proc.stdout.readline().strip()
+    pubkey_hex = identity_line.rsplit(" ", 1)[-1] if "identity" in identity_line else ""
+    yield port, pubkey_hex
+    proc.kill()
+    proc.wait()
+
+
+def test_advertise_and_parse_relay_records(relay_daemon):
+    port, pubkey_hex = relay_daemon
+    dht = DHT(start=True)
+    try:
+        assert advertise_relay(dht, "127.0.0.1", port, pubkey_hex)
+        record = dht.get(RELAY_DHT_KEY, latest=True)
+        assert record is not None
+        from hivemind_tpu.p2p.autorelay import _parse_relay_records
+
+        relays = _parse_relay_records(record)
+        assert ("127.0.0.1", port, pubkey_hex) in relays
+    finally:
+        dht.shutdown()
+
+
+def test_natted_peer_zero_config_becomes_dialable(relay_daemon):
+    port, pubkey_hex = relay_daemon
+
+    async def scenario():
+        # swarm bootstrap + a PUBLIC peer that serves the AutoNAT dial-back
+        boot = DHT(start=True)
+        maddrs = [str(m) for m in boot.get_visible_maddrs()]
+        public_dht = DHT(initial_peers=maddrs, start=True)
+        natted_dht = DHT(initial_peers=maddrs, start=True)
+
+        # the relay operator advertises the daemon in the DHT — the ONLY place
+        # relay coordinates exist in this test
+        assert advertise_relay(boot, "127.0.0.1", port, pubkey_hex)
+
+        public = await P2P.create()
+        public_auto = await AutoRelay.create(public, public_dht)
+
+        # "NATed": announces a dead port (like an unforwarded NAT mapping), so the
+        # dial-back gets connection-refused and every direct dial fails fast
+        import socket
+
+        with socket.socket() as probe_sock:
+            probe_sock.bind(("127.0.0.1", 0))
+            dead_port = probe_sock.getsockname()[1]
+        natted = await P2P.create(announce_port=dead_port, dial_timeout=1.0)
+
+        async def echo(request: test_pb2.TestRequest, context: P2PContext) -> test_pb2.TestResponse:
+            return test_pb2.TestResponse(number=request.number + 1)
+
+        await natted.add_protobuf_handler("echo", echo, test_pb2.TestRequest)
+
+        # the NATed peer can reach the public peer (outbound works behind NAT)
+        await natted.connect(public.get_visible_maddrs()[0])
+        natted_auto = await AutoRelay.create(natted, natted_dht, probe_via=public.peer_id)
+
+        # self-diagnosis found no reachable address → registered + published
+        assert natted_auto.relay_clients, "NATed peer did not register at any relay"
+        published = natted_dht.get(RELAYED_PEER_PREFIX + natted.peer_id.to_base58(), latest=True)
+        assert published is not None and published.value
+
+        # a fresh public client knows ONLY the peer id: resolver finds the circuit
+        client = await P2P.create(dial_timeout=1.0)
+        client_auto = await AutoRelay.create(client, public_dht)
+        response = await client.call_protobuf_handler(
+            natted.peer_id, "echo", test_pb2.TestRequest(number=41), test_pb2.TestResponse
+        )
+        assert response.number == 42
+
+        # second call rides the established relayed connection
+        response = await client.call_protobuf_handler(
+            natted.peer_id, "echo", test_pb2.TestRequest(number=99), test_pb2.TestResponse
+        )
+        assert response.number == 100
+
+        for auto in (client_auto, natted_auto, public_auto):
+            await auto.close()
+        for node in (client, natted, public):
+            await node.shutdown()
+        for dht in (public_dht, natted_dht, boot):
+            dht.shutdown()
+
+    asyncio.run(asyncio.wait_for(scenario(), timeout=120))
