@@ -1,0 +1,134 @@
+"""Petals-style Llama block serving from a real checkpoint (BASELINE config #5):
+synthesizes an HF-layout sharded safetensors checkpoint at the requested shape
+(or uses --checkpoint), loads it into llama_block backends (optionally int8
+weight-only), serves over RPC, and measures KV-cache decode tok/s through
+RemoteSequential."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
+
+import argparse
+import json
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+
+def synthesize_checkpoint(path: Path, hidden: int, heads: int, kv_heads: int,
+                          inner: int, layers: int) -> None:
+    from safetensors.numpy import save_file
+
+    rng = np.random.RandomState(0)
+    (path / "config.json").write_text(json.dumps({
+        "hidden_size": hidden, "num_attention_heads": heads,
+        "num_key_value_heads": kv_heads, "intermediate_size": inner,
+        "num_hidden_layers": layers, "rope_theta": 10000.0,
+    }))
+    head_dim = hidden // heads
+    weight_map = {}
+    scale = 1.0 / np.sqrt(hidden)
+    for layer in range(layers):
+        prefix = f"model.layers.{layer}."
+        tensors = {
+            prefix + "self_attn.q_proj.weight": rng.randn(heads * head_dim, hidden) * scale,
+            prefix + "self_attn.k_proj.weight": rng.randn(kv_heads * head_dim, hidden) * scale,
+            prefix + "self_attn.v_proj.weight": rng.randn(kv_heads * head_dim, hidden) * scale,
+            prefix + "self_attn.o_proj.weight": rng.randn(hidden, hidden) * scale,
+            prefix + "mlp.gate_proj.weight": rng.randn(inner, hidden) * scale,
+            prefix + "mlp.up_proj.weight": rng.randn(inner, hidden) * scale,
+            prefix + "mlp.down_proj.weight": rng.randn(hidden, inner) * scale,
+            prefix + "input_layernorm.weight": np.ones(hidden),
+            prefix + "post_attention_layernorm.weight": np.ones(hidden),
+        }
+        shard = f"model-{layer:05d}-of-{layers:05d}.safetensors"
+        save_file({k: v.astype(np.float32) for k, v in tensors.items()}, path / shard)
+        weight_map.update({name: shard for name in tensors})
+    (path / "model.safetensors.index.json").write_text(json.dumps({"weight_map": weight_map}))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--checkpoint", default=None, help="existing HF-layout dir")
+    parser.add_argument("--hidden_dim", type=int, default=1024)
+    parser.add_argument("--num_heads", type=int, default=8)
+    parser.add_argument("--num_kv_heads", type=int, default=8)
+    parser.add_argument("--inner", type=int, default=2816)
+    parser.add_argument("--layers", type=int, default=2)
+    parser.add_argument("--int8", action="store_true")
+    parser.add_argument("--prompt", type=int, default=16)
+    parser.add_argument("--generate", type=int, default=48)
+    parser.add_argument("--decode_max_len", type=int, default=128)
+    from hivemind_tpu.utils.platform import add_platform_arg, apply_platform
+
+    add_platform_arg(parser)
+    args = parser.parse_args()
+    apply_platform(args)
+
+    from hivemind_tpu.dht import DHT
+    from hivemind_tpu.moe import RemoteSequential
+    from hivemind_tpu.moe.server.llama_loader import load_llama_blocks
+    from hivemind_tpu.moe.server.server import Server
+
+    with tempfile.TemporaryDirectory() as tmp:
+        if args.checkpoint:
+            checkpoint = Path(args.checkpoint)
+        else:
+            checkpoint = Path(tmp)
+            synthesize_checkpoint(
+                checkpoint, args.hidden_dim, args.num_heads, args.num_kv_heads,
+                args.inner, args.layers,
+            )
+        load_start = time.perf_counter()
+        backends, config = load_llama_blocks(
+            checkpoint, uid_prefix="lb.",
+            weight_quantization="int8" if args.int8 else None,
+        )
+        load_seconds = time.perf_counter() - load_start
+        resident_mb = sum(b.param_bytes() for b in backends.values()) / 1e6
+
+        dht = DHT(start=True)
+        server = Server(dht, backends, decode_max_len=args.decode_max_len)
+        client_dht = None
+        try:
+            server.run_in_background(await_ready=True)
+            time.sleep(1.0)
+            client_dht = DHT(initial_peers=[str(m) for m in dht.get_visible_maddrs()], start=True)
+            pipe = RemoteSequential(client_dht, "lb.", len(backends))
+
+            rng = np.random.RandomState(1)
+            hidden = rng.randn(1, args.prompt + args.generate, config.hidden_size).astype(np.float32)
+            pipe.decode_step(hidden[:, : args.prompt], "warm", reset=True)  # compile
+            pipe.decode_step(hidden[:, args.prompt : args.prompt + 1], "warm")
+
+            start = time.perf_counter()
+            pipe.decode_step(hidden[:, : args.prompt], "bench", reset=True)
+            for t in range(args.generate):
+                pos = args.prompt + t
+                pipe.decode_step(hidden[:, pos : pos + 1], "bench")
+            elapsed = time.perf_counter() - start
+            print(json.dumps({
+                "metric": "llama_checkpoint_decode",
+                "value": round(args.generate / elapsed, 1),
+                "unit": "tok/s",
+                "extra": {
+                    "layers": len(backends), "hidden": config.hidden_size,
+                    "inner": config.intermediate_size,
+                    "int8": args.int8, "resident_mb": round(resident_mb, 1),
+                    "load_seconds": round(load_seconds, 2),
+                    "prompt": args.prompt, "generated": args.generate,
+                    "prefill_included_tok_s": round((args.prompt + args.generate) / elapsed, 1),
+                },
+            }))
+        finally:
+            if client_dht is not None:
+                client_dht.shutdown()
+            server.shutdown()
+            dht.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -34,6 +34,21 @@ def main():
     parser.add_argument("--max_batch_size", type=int, default=4096)
     parser.add_argument("--initial_peers", nargs="*", default=[])
     parser.add_argument("--checkpoint_dir", default=None)
+    parser.add_argument("--llama_checkpoint", default=None,
+                        help="serve a real (sharded) HF-layout Llama checkpoint: "
+                             "decoder layers load into llama_block backends "
+                             "(BASELINE config #5 Petals-style block server)")
+    parser.add_argument("--llama_layers", default=None,
+                        help="'start:stop' layer range of --llama_checkpoint to "
+                             "serve (default: HBM-budgeted from the start, or all "
+                             "when the platform reports no memory limit)")
+    parser.add_argument("--llama_uid_prefix", default="llama.")
+    parser.add_argument("--weight_quantization", choices=["int8"], default=None,
+                        help="serve blocks int8 weight-only via the blockwise "
+                             "codec (4x less resident HBM; inference-only)")
+    parser.add_argument("--decode_sessions_budget", type=int, default=8,
+                        help="concurrent decode sessions the HBM plan reserves "
+                             "KV-cache space for")
     parser.add_argument("--learning_rate", type=float, default=1e-3)
     parser.add_argument("--increase_file_limit", action="store_true",
                         help="raise RLIMIT_NOFILE for many concurrent connections")
@@ -62,6 +77,11 @@ def main():
 
     import optax
 
+    if args.llama_checkpoint:
+        server = _serve_llama_checkpoint(args)
+        _run_forever(server)
+        return
+
     server = Server.create(
         num_experts=args.num_experts,
         expert_uids=args.expert_uids,
@@ -76,6 +96,69 @@ def main():
         optim_factory=lambda: optax.adam(args.learning_rate),
         start=True,
     )
+    _run_forever(server)
+
+
+def _serve_llama_checkpoint(args) -> Server:
+    """BASELINE config #5: serve a real checkpoint's decoder layers, choosing how
+    many fit this chip when no explicit range is given."""
+    from hivemind_tpu.dht import DHT
+    from hivemind_tpu.moe.server.llama_loader import (
+        LlamaCheckpointConfig,
+        decode_cache_bytes,
+        device_hbm_bytes,
+        load_llama_blocks,
+        plan_block_capacity,
+    )
+
+    config = LlamaCheckpointConfig.load(args.llama_checkpoint)
+    if args.llama_layers:
+        start, _, stop = args.llama_layers.partition(":")
+        layers = range(int(start or 0), int(stop or config.num_hidden_layers))
+    else:
+        layers = range(config.num_hidden_layers)
+        hbm = device_hbm_bytes()
+        if hbm is not None:
+            # measure one real block, then plan with KV-cache headroom
+            probe, _ = load_llama_blocks(
+                args.llama_checkpoint, layers=[0], uid_prefix="_probe.",
+                weight_quantization=args.weight_quantization,
+            )
+            block_bytes = next(iter(probe.values())).param_bytes()
+            del probe  # release the probe block before the real load fills the plan
+            fit = plan_block_capacity(
+                block_bytes,
+                hbm_bytes=hbm,
+                decode_sessions=args.decode_sessions_budget,
+                cache_bytes_per_session_block=decode_cache_bytes(
+                    config, batch=1, max_len=args.decode_max_len
+                ),
+            )
+            layers = range(min(fit, config.num_hidden_layers))
+            logger.info(
+                f"HBM plan: {block_bytes / 1e6:.0f} MB/block, "
+                f"{hbm / 1e9:.1f} GB chip → serving {len(layers)} of "
+                f"{config.num_hidden_layers} layers"
+            )
+    backends, _config = load_llama_blocks(
+        args.llama_checkpoint,
+        layers=layers,
+        uid_prefix=args.llama_uid_prefix,
+        weight_quantization=args.weight_quantization,
+        max_batch_size=args.max_batch_size,
+    )
+    dht = DHT(initial_peers=args.initial_peers, start=True)
+    server = Server(
+        dht, backends, decode_max_len=args.decode_max_len,
+        # the HBM plan reserved KV space for exactly this many sessions: cap the
+        # session manager to it so the reservation is real, not advisory
+        decode_max_sessions=args.decode_sessions_budget,
+    )
+    server.run_in_background(await_ready=True)
+    return server
+
+
+def _run_forever(server: Server) -> None:
     for maddr in server.dht.get_visible_maddrs():
         logger.info(f"listening: {maddr}")
     logger.info(f"serving {len(server.backends)} experts: {sorted(server.backends)[:8]}…")

@@ -28,13 +28,16 @@ _STREAM_CHUNK = 2**20  # 1 MiB chunks inside stream replies
 
 
 class ConnectionHandler(ServicerBase):
-    def __init__(self, backends: Dict[str, ModuleBackend], decode_max_len: int = 256):
+    def __init__(self, backends: Dict[str, ModuleBackend], decode_max_len: int = 256,
+                 decode_max_sessions: int = 64):
         from hivemind_tpu.moe.server.decode_session import DecodeSessionManager
 
         self.backends = backends
         self.forward_pools: Dict[str, TaskPool] = {}
         self.backward_pools: Dict[str, TaskPool] = {}
-        self.decode_sessions = DecodeSessionManager(backends, max_len=decode_max_len)
+        self.decode_sessions = DecodeSessionManager(
+            backends, max_len=decode_max_len, max_sessions=decode_max_sessions
+        )
         for name, backend in backends.items():
             self.forward_pools[name] = TaskPool(
                 backend.forward, f"{name}_forward", max_batch_size=backend.max_batch_size

@@ -117,7 +117,11 @@ class DecodeSessionManager:
         module = self.backends[uid].module
 
         def step(params, x, cache_k, cache_v, index):
-            return module.apply({"params": params}, x, cache_k, cache_v, index)
+            from hivemind_tpu.ops.quantized_params import dequantize_tree
+
+            # int8 weight-only backends: materialize dense weights inside the jit
+            # (identity for plain fp32 trees)
+            return module.apply({"params": dequantize_tree(params)}, x, cache_k, cache_v, index)
 
         return step
 

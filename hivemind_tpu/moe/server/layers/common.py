@@ -169,6 +169,7 @@ class LlamaBlockExpert(nn.Module):
     num_heads: int = 8
     num_kv_heads: int = 0  # 0 = multi-head (Llama-7B); set lower for GQA (Llama-70B style)
     rope_theta: float = 10000.0
+    ffn_inner: int = 0  # 0 = the 8/3 rule below; real checkpoints set intermediate_size
 
     def init_decode_cache(self, batch: int, max_len: int):
         kv_heads = self.num_kv_heads or self.num_heads
@@ -206,7 +207,7 @@ class LlamaBlockExpert(nn.Module):
             attn = context.reshape(batch, seq, hid)
         x = x + dense(hid, "attention_out")(attn)
         normed = nn.RMSNorm(dtype=jnp.bfloat16, name="ffn_norm")(x)
-        inner = -(-8 * hid // 3 // 8) * 8  # 8/3 * hid rounded up to a multiple of 8
+        inner = self.ffn_inner or -(-8 * hid // 3 // 8) * 8  # 8/3*hid rounded up to 8
         gate = dense(inner, "ffn_gate")(normed)
         up = dense(inner, "ffn_up")(normed)
         y = (x + dense(hid, "ffn_down")(jax.nn.silu(gate) * up)).astype(jnp.float32)

@@ -39,6 +39,11 @@ class ModuleBackend:
     :param optimizer: optax transformation applied on every backward batch
     :param sample_input: schema-defining input WITH batch dim (single-input experts)
     :param sample_inputs: schema-defining inputs for multi-input experts
+    :param weight_quantization: ``"int8"`` stores the expert's weights with the
+        repo's blockwise absmax codec (4x less resident memory; dense bf16/fp32
+        weights are materialized transiently inside the jit). Serving-only: the
+        backend refuses backward calls (the Petals-style Llama-7B block server of
+        BASELINE config #5 serves frozen pretrained blocks).
     """
 
     def __init__(
@@ -51,21 +56,28 @@ class ModuleBackend:
         sample_inputs: Optional[Sequence[np.ndarray]] = None,
         max_batch_size: int = 4096,
         rng_seed: int = 0,
+        weight_quantization: Optional[str] = None,
     ):
         assert (sample_input is None) != (sample_inputs is None), (
             "provide exactly one of sample_input / sample_inputs"
         )
         if sample_inputs is None:
             sample_inputs = (sample_input,)
+        assert weight_quantization in (None, "int8"), weight_quantization
         self.name, self.module, self.optimizer = name, module, optimizer
         self.max_batch_size = max_batch_size
+        self.weight_quantization = weight_quantization
         samples = tuple(jnp.asarray(np.asarray(s)[:1]) for s in sample_inputs)
         self.params = module.init(jax.random.PRNGKey(rng_seed), *samples)["params"]
-        self.opt_state = optimizer.init(self.params)
+        self.opt_state = optimizer.init(self.params) if weight_quantization is None else None
         self._state_lock = threading.Lock()
         self.update_count = 0
 
         sample_out = module.apply({"params": self.params}, *samples)
+        if weight_quantization is not None:
+            from hivemind_tpu.ops.quantized_params import quantize_params
+
+            self.params = quantize_params(self.params)
         outs = tuple(sample_out) if isinstance(sample_out, (tuple, list)) else (sample_out,)
         self.num_inputs, self.num_outputs = len(samples), len(outs)
         self._outputs_are_tuple = isinstance(sample_out, (tuple, list))
@@ -79,7 +91,9 @@ class ModuleBackend:
 
         @jax.jit
         def _forward(params, *xs):
-            return _as_tuple(module.apply({"params": params}, *xs))
+            from hivemind_tpu.ops.quantized_params import dequantize_tree
+
+            return _as_tuple(module.apply({"params": dequantize_tree(params)}, *xs))
 
         @jax.jit
         def _backward(params, opt_state, xs, grad_outs):
@@ -110,6 +124,27 @@ class ModuleBackend:
         with self._state_lock:
             return self.params
 
+    def load_params(self, params) -> None:
+        """Replace the expert's weights (e.g. with a pretrained checkpoint's). The
+        tree must match the init schema. Quantized backends re-encode to int8;
+        trainable ones restart optimizer statistics for the new weights."""
+        with self._state_lock:
+            if self.weight_quantization is not None:
+                from hivemind_tpu.ops.quantized_params import quantize_params
+
+                self.params = quantize_params(params)
+            else:
+                self.params = jax.tree_util.tree_map(jnp.asarray, params)
+                self.opt_state = self.optimizer.init(self.params)
+
+    def param_bytes(self) -> int:
+        """Resident bytes of this expert's weights (int8 codes count, not the
+        transient dense copies) — the HBM budgeting input."""
+        from hivemind_tpu.ops.quantized_params import tree_param_bytes
+
+        with self._state_lock:
+            return tree_param_bytes(self.params)
+
     def forward(self, *inputs: np.ndarray) -> List[np.ndarray]:
         """Inference on a concatenated batch (no parameter updates)."""
         assert len(inputs) == self.num_inputs, (len(inputs), self.num_inputs)
@@ -122,6 +157,11 @@ class ModuleBackend:
         """Gradients wrt every input; ALSO applies one optimizer update to the expert
         (reference on_backward: the server trains on every backward call).
         ``tensors`` = the forward inputs followed by one grad per output."""
+        if self.weight_quantization is not None:
+            raise RuntimeError(
+                f"expert {self.name!r} serves int8 weight-only (inference-only): "
+                f"backward/training is not supported on quantized weights"
+            )
         assert len(tensors) == self.num_inputs + self.num_outputs, (
             len(tensors), self.num_inputs, self.num_outputs,
         )
@@ -152,17 +192,35 @@ class ModuleBackend:
     def state_dict(self) -> bytes:
         import flax.serialization
 
+        from hivemind_tpu.ops.quantized_params import dequantize_tree
+
         with self._state_lock:
+            # quantized backends serialize the dense form (msgpack cannot carry the
+            # QuantizedTensor nodes); load_state_dict re-encodes, so the round-trip
+            # is exact for int8 serving
             return flax.serialization.to_bytes(
-                {"params": self.params, "opt_state": self.opt_state, "updates": self.update_count}
+                {
+                    "params": dequantize_tree(self.params),
+                    "opt_state": self.opt_state if self.opt_state is not None else {},
+                    "updates": self.update_count,
+                }
             )
 
     def load_state_dict(self, blob: bytes) -> None:
         import flax.serialization
 
+        from hivemind_tpu.ops.quantized_params import dequantize_tree, quantize_params
+
         with self._state_lock:
-            template = {"params": self.params, "opt_state": self.opt_state, "updates": 0}
+            template = {
+                "params": dequantize_tree(self.params),
+                "opt_state": self.opt_state if self.opt_state is not None else {},
+                "updates": 0,
+            }
             restored = flax.serialization.from_bytes(template, blob)
-            self.params = restored["params"]
-            self.opt_state = restored["opt_state"]
+            if self.weight_quantization is not None:
+                self.params = quantize_params(restored["params"])
+            else:
+                self.params = restored["params"]
+                self.opt_state = restored["opt_state"]
             self.update_count = int(restored["updates"])

@@ -41,11 +41,14 @@ class Server:
         update_period: float = 30.0,
         checkpoint_dir: Optional[Path] = None,
         decode_max_len: int = 256,
+        decode_max_sessions: int = 64,
         loop_runner: Optional[LoopRunner] = None,
     ):
         self.dht, self.backends = dht, backends
         self.update_period = update_period
-        self.handler = ConnectionHandler(backends, decode_max_len=decode_max_len)
+        self.handler = ConnectionHandler(
+            backends, decode_max_len=decode_max_len, decode_max_sessions=decode_max_sessions
+        )
         self.runtime = Runtime(self.handler.all_pools())
         self.checkpoint_saver = (
             CheckpointSaver(backends, checkpoint_dir) if checkpoint_dir is not None else None
