@@ -41,6 +41,13 @@ def main():
                         help="shared vocab file for text corpora: ALL peers must use the same token "
                              "mapping (first peer writes it, the rest load it)")
     parser.add_argument("--seed", type=int, default=None, help="data sampling seed (default: random per peer)")
+    parser.add_argument("--backup_every", type=int, default=30,
+                        help="healthy steps between in-memory state backups for "
+                             "NaN-restore (0 disables the guard; reference "
+                             "run_trainer.py:62-130)")
+    parser.add_argument("--metrics_jsonl", default=None,
+                        help="append per-report metrics as JSON lines (wandb-style "
+                             "key/value records, offline-friendly)")
     from hivemind_tpu.utils.platform import add_platform_arg, apply_platform
 
     add_platform_arg(parser)
@@ -128,26 +135,46 @@ def main():
         hf_tokenizer=args.hf_tokenizer, vocab_path=args.vocab_path,
         seed=args.seed if args.seed is not None else int(time.time() * 1000) % 2**31,
     )
+    from hivemind_tpu.optim import NaNGuard
+    from hivemind_tpu.utils.profiling import JsonlMetricsSink
+
+    guard = NaNGuard(opt, backup_every=args.backup_every) if args.backup_every > 0 else None
+    metrics_sink = JsonlMetricsSink(args.metrics_jsonl)
+
     step = 0
     loss_ema = None
     while step < args.max_steps:
         batch = {k: jnp.asarray(v) for k, v in sample_batch(args.batch_size).items()}
         loss, grads = loss_and_grad(opt.params, batch)
-        opt.step(grads)
         loss_value = float(loss)
-        loss_ema = loss_value if loss_ema is None else 0.95 * loss_ema + 0.05 * loss_value
+        if guard is not None:
+            guard.step(loss_value, grads)  # restores the backup on NaN/Inf
+        else:
+            opt.step(grads)
+        if np.isfinite(loss_value):
+            loss_ema = loss_value if loss_ema is None else 0.95 * loss_ema + 0.05 * loss_value
         step += 1
         if step % 10 == 0:
             progress = opt.tracker.global_progress
+            ema_text = f"{loss_ema:.4f}" if loss_ema is not None else "n/a"
             logger.info(
-                f"step {step} epoch {opt.local_epoch} loss {loss_ema:.4f} "
+                f"step {step} epoch {opt.local_epoch} loss {ema_text} "
                 f"(swarm: {progress.num_peers} peers, {progress.samples_accumulated}/"
                 f"{args.target_batch_size} samples)"
+                + (f" [{guard.restores} NaN restores]" if guard is not None and guard.restores else "")
             )
+            metrics_sink.log({
+                "step": step, "epoch": opt.local_epoch, "loss": loss_value,
+                "loss_ema": loss_ema, "num_peers": progress.num_peers,
+                "samples_accumulated": progress.samples_accumulated,
+                "time": time.time(),
+            })
 
     # reached max_steps (benchmarks/smoke runs): leave the swarm cleanly so the
     # process actually exits instead of hanging on background threads
-    logger.info(f"training finished after {step} steps at epoch {opt.local_epoch}, final loss {loss_ema:.4f}")
+    final_text = f"{loss_ema:.4f}" if loss_ema is not None else "n/a"
+    logger.info(f"training finished after {step} steps at epoch {opt.local_epoch}, final loss {final_text}")
+    metrics_sink.close()
     opt.shutdown()
     dht.shutdown()
 

@@ -15,6 +15,12 @@ def main():
     parser.add_argument("--refresh_period", type=float, default=5.0)
     parser.add_argument("--max_reports", type=int, default=0,
                         help="exit after this many progress reports (0 = run forever)")
+    parser.add_argument("--wandb_project", default=None,
+                        help="log swarm metrics to Weights & Biases (needs the "
+                             "wandb package; reference monitor parity)")
+    parser.add_argument("--metrics_jsonl", default=None,
+                        help="append each report as a JSON line (offline "
+                             "wandb-style sink; survives without any service)")
     args = parser.parse_args()
 
     import jax
@@ -28,6 +34,17 @@ def main():
     from hivemind_tpu.utils.timed_storage import get_dht_time
 
     logger = get_logger("monitor")
+    wandb_run = None
+    if args.wandb_project:
+        try:
+            import wandb
+
+            wandb_run = wandb.init(project=args.wandb_project, job_type="monitor")
+        except ImportError:
+            logger.warning("wandb is not installed; falling back to --metrics_jsonl/logs")
+    from hivemind_tpu.utils.profiling import JsonlMetricsSink
+
+    metrics_sink = JsonlMetricsSink(args.metrics_jsonl)
     # progress records are signature-protected: without this validator their
     # signatures are never stripped and the records fail to deserialize
     dht = DHT(
@@ -59,10 +76,21 @@ def main():
             f"epoch {epoch}: {len(records)} peers, {samples} samples accumulated, "
             f"{sps:.0f} samples/s aggregate"
         )
+        metrics = {
+            "epoch": epoch, "num_peers": len(records),
+            "samples_accumulated": samples, "samples_per_second": sps,
+            "time": get_dht_time(),
+        }
+        if wandb_run is not None:
+            wandb_run.log(metrics)
+        metrics_sink.log(metrics)
         reports += 1
         if args.max_reports and reports >= args.max_reports:
             break
 
+    if wandb_run is not None:
+        wandb_run.finish()
+    metrics_sink.close()
     dht.shutdown()
 
 

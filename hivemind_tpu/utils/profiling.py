@@ -102,3 +102,30 @@ class StepProfiler:
             if self.achieved_flops is None
             else round(self.achieved_flops / 1e12, 3),
         }
+
+
+class JsonlMetricsSink:
+    """Append metric records as JSON lines — the offline wandb-style sink shared
+    by the flagship recipe's trainer and monitor. Non-finite floats serialize as
+    null so every line stays strict JSON (jq/pandas-parsable)."""
+
+    def __init__(self, path: Optional[str]):
+        self._file = open(path, "a") if path else None
+
+    def log(self, record: Dict[str, Any]) -> None:
+        if self._file is None:
+            return
+        import json
+        import math
+
+        clean = {
+            key: (None if isinstance(value, float) and not math.isfinite(value) else value)
+            for key, value in record.items()
+        }
+        self._file.write(json.dumps(clean, allow_nan=False) + "\n")
+        self._file.flush()
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None

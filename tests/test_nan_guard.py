@@ -1,0 +1,91 @@
+"""NaN-restore from periodic in-memory backups (VERDICT r2 next-round #9;
+reference examples/albert/run_trainer.py:62-130): a poisoned step restores the
+last healthy state instead of corrupting the run."""
+
+import time
+
+import numpy as np
+import optax
+
+from hivemind_tpu.dht import DHT
+from hivemind_tpu.optim import NaNGuard, Optimizer
+
+
+def _make_solo_optimizer(dht):
+    params = {"w": np.ones(8, np.float32)}
+    return Optimizer(
+        dht=dht, run_id="nan_guard_test", target_batch_size=4,
+        params=params, optimizer=optax.sgd(0.1), batch_size_per_step=4,
+        matchmaking_time=0.5,
+    )
+
+
+def _drive_until_update(guard, grads, timeout=45.0):
+    """Healthy steps until an epoch transition applies an optax update."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        params = guard.step(1.0, grads)
+        if not np.allclose(np.asarray(params["w"]), 1.0):
+            return params
+        time.sleep(0.25)
+    raise AssertionError("no epoch transition within the deadline")
+
+
+def test_nan_restores_last_backup_and_drops_gradients():
+    dht = DHT(start=True)
+    opt = _make_solo_optimizer(dht)
+    try:
+        guard = NaNGuard(opt, backup_every=1)
+        grads = {"w": np.full(8, 0.5, np.float32)}
+        _drive_until_update(guard, grads)
+
+        # the state right before the next healthy step is what its backup holds
+        w_backup = np.asarray(opt.params["w"]).copy()
+        epoch_backup = opt.local_epoch
+        guard.step(1.0, grads)
+
+        poisoned = {"w": np.full(8, 1e30, np.float32)}
+        p = guard.step(float("nan"), poisoned)
+        assert guard.restores == 1 and guard.skipped_steps == 1
+        # poisoned gradients dropped AND state rolled back to the backup
+        np.testing.assert_allclose(np.asarray(p["w"]), w_backup)
+        assert opt.local_epoch == epoch_backup
+
+        # +inf is caught the same way
+        p = guard.step(float("inf"), poisoned)
+        assert guard.restores == 2
+        np.testing.assert_allclose(np.asarray(p["w"]), w_backup)
+    finally:
+        opt.shutdown()
+        dht.shutdown()
+
+
+def test_nan_before_any_backup_skips_but_survives():
+    dht = DHT(start=True)
+    opt = _make_solo_optimizer(dht)
+    try:
+        guard = NaNGuard(opt, backup_every=10)
+        w0 = np.asarray(opt.params["w"]).copy()
+        p = guard.step(float("nan"), {"w": np.full(8, 7.0, np.float32)})
+        assert guard.restores == 0 and guard.skipped_steps == 1
+        np.testing.assert_allclose(np.asarray(p["w"]), w0)  # untouched
+    finally:
+        opt.shutdown()
+        dht.shutdown()
+
+
+def test_check_grads_catches_finite_loss_nonfinite_grads():
+    dht = DHT(start=True)
+    opt = _make_solo_optimizer(dht)
+    try:
+        guard = NaNGuard(opt, backup_every=1, check_grads=True)
+        w_backup = np.asarray(opt.params["w"]).copy()
+        guard.step(1.0, {"w": np.full(8, 0.5, np.float32)})  # backup taken pre-step
+
+        bad = {"w": np.array([1.0] * 7 + [np.nan], np.float32)}
+        p = guard.step(0.9, bad)  # loss fine, one grad element NaN
+        assert guard.skipped_steps == 1 and guard.restores == 1
+        np.testing.assert_allclose(np.asarray(p["w"]), w_backup)
+    finally:
+        opt.shutdown()
+        dht.shutdown()
