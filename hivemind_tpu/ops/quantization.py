@@ -97,19 +97,66 @@ def quantile_quantize(flat: jax.Array):
     Large tensors estimate the codebook from a hash-sampled 2^20-element subset
     instead of sorting everything: 4096 samples per bucket keeps the boundary
     estimates well within one bucket width (measured: identical round-trip error
-    on 10M gaussian elements, ~4x faster). This replaces the reference's
-    thread-pool quantile-of-quantiles approximation (quantization.py:77-122) —
-    same idea, sampling instead of parallel chunking.
+    on 10M gaussian elements). This replaces the reference's thread-pool
+    quantile-of-quantiles approximation (quantization.py:77-122) — same idea,
+    sampling instead of parallel chunking.
+
+    The whole codec runs in NUMPY on the host: its output feeds wire
+    serialization (host bytes) anyway, and XLA:CPU executes the gather-heavy
+    sample/quantile/searchsorted steps as scalar loops — the numpy path measured
+    ~5x faster at 10M elements (846 → ~170 ms) with identical error. The jitted
+    helpers above remain for callers that want the math on-device.
 
     :returns: (uint8 codes, fp32 codebook [256])
     """
-    flat32 = jnp.asarray(flat).astype(jnp.float32).reshape(-1)
+    flat32 = np.asarray(flat, dtype=np.float32).reshape(-1)
+    if flat32.size == 0:
+        return np.zeros(0, np.uint8), np.zeros(UNIFORM_NUM_BUCKETS, np.float32)
     if flat32.size > QUANTILE_SAMPLE_SIZE:
-        codebook = _quantile_codebook(_quantile_sample(flat32))
+        # layout-independent multiplicative-hash sample (see _quantile_sample)
+        indices = (
+            np.arange(QUANTILE_SAMPLE_SIZE, dtype=np.uint64) * np.uint64(2654435761)
+        ) % np.uint64(flat32.size)
+        sample = np.sort(flat32[indices.astype(np.int64)])
     else:
-        codebook = _quantile_codebook(flat32)
-    codes = _quantile_encode(flat32, codebook)
-    return codes, codebook.astype(jnp.float32)
+        sample = np.sort(flat32)
+    # evenly spaced order statistics of the sorted sample = empirical quantiles
+    positions = np.linspace(
+        0.5 / UNIFORM_NUM_BUCKETS, 1 - 0.5 / UNIFORM_NUM_BUCKETS, UNIFORM_NUM_BUCKETS
+    ) * (sample.size - 1)
+    codebook = sample[np.round(positions).astype(np.int64)].astype(np.float32)
+    edges = (codebook[1:] + codebook[:-1]) / 2
+    return _encode_against_edges(flat32, edges), codebook
+
+
+_ENCODE_GRID = 1 << 16
+
+
+def _encode_against_edges(flat32: np.ndarray, edges: np.ndarray) -> np.ndarray:
+    """Exact bucket assignment ~4x faster than ``np.searchsorted`` on the full
+    array: a uniform grid LUT resolves every element whose grid bin lies wholly
+    inside one bucket (~99% of them); only elements in bins that straddle a
+    bucket edge fall back to a real binary search, so results are bit-identical
+    to ``np.searchsorted(edges, flat32)``."""
+    # grid arithmetic runs in float64 so the per-element binning is consistent
+    # with the grid boundaries for ANY float32 data (with a float32 grid, data
+    # like N(1e4, 1) makes ulp(lo) comparable to the grid step and bins disagree
+    # with grid_starts — codes then silently differ from searchsorted's)
+    lo, hi = float(edges[0]), float(edges[-1])
+    span = hi - lo
+    if not span > 0:  # degenerate codebook (constant tensor): no grid to build
+        return np.searchsorted(edges, flat32).astype(np.uint8)
+    scale = (_ENCODE_GRID - 2) / span
+    grid_starts = lo + np.arange(_ENCODE_GRID + 1, dtype=np.float64) / scale
+    lut = np.searchsorted(edges, grid_starts).astype(np.uint8)
+    safe = lut[:-1] == lut[1:]
+    bins = np.clip(
+        ((flat32.astype(np.float64) - lo) * scale).astype(np.int64), 0, _ENCODE_GRID - 1
+    )
+    codes = lut[bins]
+    unsafe = ~safe[bins]
+    codes[unsafe] = np.searchsorted(edges, flat32[unsafe]).astype(np.uint8)
+    return codes
 
 
 def dequantize_with_codebook(codes: np.ndarray, codebook: np.ndarray) -> np.ndarray:
