@@ -102,6 +102,14 @@ if proc_id == 0:
         np.testing.assert_allclose(tensors[0], expected_b, rtol=1e-6, atol=1e-7)
         np.testing.assert_allclose(tensors[1], expected_w, rtol=1e-6, atol=1e-7)
     plain_peer.shutdown(); plain_dht.shutdown()
+
+# ---- failure path: the other swarm peer is gone, so the network round cannot
+# form a group; EVERY process must observe ok=False and device state unchanged
+ok_fail = slice_avg.step(timeout=6)
+assert not ok_fail, f"[{proc_id}] round unexpectedly succeeded with no peers"
+check_shards(slice_avg.device_tree["w"], expected_w)
+check_shards(slice_avg.device_tree["b"], expected_b)
+
 slice_avg.shutdown()
 print(f"SLICE_OK_{proc_id}", flush=True)
 """
