@@ -236,27 +236,30 @@ class AutoRelay:
 
     # ------------------------------------------------------------------ maintenance
 
+    async def _maintenance_once(self) -> None:
+        """One maintenance pass: RE-probe NAT status while not relayed (a peer that
+        diagnosed itself before it had anyone to probe through — unknown → assumed
+        reachable — must register once evidence of being NATed appears), drop
+        registrations whose control line died, and re-register/re-publish."""
+        if not self._natted:
+            self._natted = not await self._probe_reachable(self._probe_via)
+        if self._natted:
+            dead = [
+                key
+                for key, client in self.relay_clients.items()
+                if client._control_task is None or client._control_task.done()
+            ]
+            for key in dead:
+                client = self.relay_clients.pop(key)
+                await client.close()
+            await self._ensure_registrations()
+
     async def _maintenance_loop(self) -> None:
-        """Refresh published records at half-life; revive dropped registrations; and
-        RE-probe NAT status while not relayed — a peer that diagnosed itself before
-        it had anyone to probe through (unknown → assumed reachable) must register
-        once evidence of being NATed appears."""
         interval = max(self.ttl / 2.0, 5.0)
         while not self._closed:
             await asyncio.sleep(interval)
             try:
-                if not self._natted:
-                    self._natted = not await self._probe_reachable(self._probe_via)
-                if self._natted:
-                    dead = [
-                        key
-                        for key, client in self.relay_clients.items()
-                        if client._control_task is None or client._control_task.done()
-                    ]
-                    for key in dead:
-                        client = self.relay_clients.pop(key)
-                        await client.close()
-                    await self._ensure_registrations()
+                await self._maintenance_once()
             except Exception as e:
                 logger.warning(f"auto-relay maintenance failed: {e!r}")
 

@@ -109,3 +109,55 @@ def test_natted_peer_zero_config_becomes_dialable(relay_daemon):
             dht.shutdown()
 
     asyncio.run(asyncio.wait_for(scenario(), timeout=120))
+
+
+def test_maintenance_replaces_dead_relay(relay_daemon, tmp_path):
+    """Failure recovery: the relay a NATed peer registered at dies; a maintenance
+    pass detects the dropped control line and re-registers at another advertised
+    relay, republishing circuits (reference auto-relay keeps peers dialable
+    through relay churn)."""
+    import subprocess
+
+    port, pubkey_hex = relay_daemon
+
+    async def scenario():
+        # a second, short-lived relay the peer will register at FIRST
+        victim = subprocess.Popen(
+            [str(RELAY_BIN), "0"], stdout=subprocess.PIPE, text=True
+        )
+        victim_port = int(victim.stdout.readline().strip().rsplit(" ", 1)[-1])
+        victim_key = victim.stdout.readline().strip().rsplit(" ", 1)[-1]
+        try:
+            dht = DHT(start=True)
+            assert advertise_relay(dht, "127.0.0.1", victim_port, victim_key)
+            natted = await P2P.create(dial_timeout=1.0)
+            auto = await AutoRelay.create(natted, dht, max_relays=1, force_relay=True)
+            assert set(auto.relay_clients) == {("127.0.0.1", victim_port)}
+
+            # the registered relay dies; the survivor is advertised in its place
+            victim.kill()
+            victim.wait()
+            assert advertise_relay(dht, "127.0.0.1", port, pubkey_hex)
+
+            deadline = asyncio.get_event_loop().time() + 30
+            while asyncio.get_event_loop().time() < deadline:
+                await auto._maintenance_once()
+                if ("127.0.0.1", port) in auto.relay_clients:
+                    break
+                await asyncio.sleep(0.5)
+            assert set(auto.relay_clients) == {("127.0.0.1", port)}, auto.relay_clients
+
+            published = dht.get(RELAYED_PEER_PREFIX + natted.peer_id.to_base58(), latest=True)
+            assert published is not None
+            endpoints = {c["endpoint"] for c in published.value}
+            assert f"127.0.0.1:{port}" in endpoints
+
+            await auto.close()
+            await natted.shutdown()
+            dht.shutdown()
+        finally:
+            if victim.poll() is None:
+                victim.kill()
+                victim.wait()
+
+    asyncio.run(asyncio.wait_for(scenario(), timeout=120))
