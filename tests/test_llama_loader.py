@@ -199,3 +199,38 @@ def test_hbm_planning_7b_shapes():
 
     with pytest.raises(ValueError):
         plan_block_capacity(1, hbm_bytes=None, device=None)  # CPU reports no limit
+
+
+def test_single_file_checkpoint_and_missing_tensor(tmp_path):
+    """Single-file model.safetensors checkpoints load identically to sharded ones,
+    and a truncated checkpoint fails with a clear KeyError naming the tensor."""
+    from safetensors.numpy import save_file
+
+    _write_checkpoint(tmp_path)
+    sharded = ShardedSafetensorsReader(tmp_path)
+
+    single_dir = tmp_path / "single"
+    single_dir.mkdir()
+    (single_dir / "config.json").write_text((tmp_path / "config.json").read_text())
+    save_file({name: sharded.get(name) for name in sharded.names()},
+              single_dir / "model.safetensors")
+
+    backends, config = load_llama_blocks(single_dir, uid_prefix="sf.")
+    assert len(backends) == LAYERS and config.hidden_size == HID
+    x = np.random.RandomState(9).randn(1, 8, HID).astype(np.float32)
+    out = x
+    for layer in range(LAYERS):
+        out = backends[f"sf.{layer}"].forward(out)[0]
+    ref = _local_reference(tmp_path, x)
+    assert np.linalg.norm(out - ref) / np.linalg.norm(ref) < 1e-2  # bf16 compute noise
+
+    truncated = tmp_path / "truncated"
+    truncated.mkdir()
+    (truncated / "config.json").write_text((tmp_path / "config.json").read_text())
+    partial = {n: sharded.get(n) for n in sharded.names() if "mlp.down_proj" not in n}
+    save_file(partial, truncated / "model.safetensors")
+    with pytest.raises(KeyError, match="mlp.down_proj"):
+        load_llama_blocks(truncated, uid_prefix="tr.")
+
+    with pytest.raises(FileNotFoundError):
+        ShardedSafetensorsReader(tmp_path / "nowhere")
