@@ -170,6 +170,7 @@ class LlamaBlockExpert(nn.Module):
     num_kv_heads: int = 0  # 0 = multi-head (Llama-7B); set lower for GQA (Llama-70B style)
     rope_theta: float = 10000.0
     ffn_inner: int = 0  # 0 = the 8/3 rule below; real checkpoints set intermediate_size
+    rms_eps: float = 1e-6  # real checkpoints set rms_norm_eps (Llama-2: 1e-5)
 
     def init_decode_cache(self, batch: int, max_len: int):
         kv_heads = self.num_kv_heads or self.num_heads
@@ -188,7 +189,7 @@ class LlamaBlockExpert(nn.Module):
         dense = lambda n, name: nn.Dense(
             n, use_bias=False, dtype=jnp.bfloat16, param_dtype=jnp.float32, name=name
         )
-        normed = nn.RMSNorm(dtype=jnp.bfloat16, name="attention_norm")(x)
+        normed = nn.RMSNorm(epsilon=self.rms_eps, dtype=jnp.bfloat16, name="attention_norm")(x)
         q = dense(heads * head_dim, "query")(normed).reshape(batch, seq, heads, head_dim)
         k = dense(kv_heads * head_dim, "key")(normed).reshape(batch, seq, kv_heads, head_dim)
         v = dense(kv_heads * head_dim, "value")(normed).reshape(batch, seq, kv_heads, head_dim)
@@ -206,7 +207,7 @@ class LlamaBlockExpert(nn.Module):
             )
             attn = context.reshape(batch, seq, hid)
         x = x + dense(hid, "attention_out")(attn)
-        normed = nn.RMSNorm(dtype=jnp.bfloat16, name="ffn_norm")(x)
+        normed = nn.RMSNorm(epsilon=self.rms_eps, dtype=jnp.bfloat16, name="ffn_norm")(x)
         inner = self.ffn_inner or -(-8 * hid // 3 // 8) * 8  # 8/3*hid rounded up to 8
         gate = dense(inner, "ffn_gate")(normed)
         up = dense(inner, "ffn_up")(normed)

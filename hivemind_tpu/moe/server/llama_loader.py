@@ -41,6 +41,7 @@ class LlamaCheckpointConfig:
     intermediate_size: int
     num_hidden_layers: int
     rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-6  # HF LlamaConfig default; Llama-2 ships 1e-5
 
     @classmethod
     def load(cls, checkpoint_dir) -> "LlamaCheckpointConfig":
@@ -53,6 +54,7 @@ class LlamaCheckpointConfig:
             intermediate_size=int(raw["intermediate_size"]),
             num_hidden_layers=int(raw["num_hidden_layers"]),
             rope_theta=float(raw.get("rope_theta", 10000.0)),
+            rms_norm_eps=float(raw.get("rms_norm_eps", 1e-6)),
         )
 
 
@@ -148,6 +150,7 @@ def load_llama_blocks(
             num_kv_heads=config.num_key_value_heads,
             rope_theta=config.rope_theta,
             ffn_inner=config.intermediate_size,
+            rms_eps=config.rms_norm_eps,
         )
         backend = ModuleBackend(
             f"{uid_prefix}{layer}",
@@ -217,3 +220,74 @@ def plan_block_capacity(
     if per_block <= 0:
         return 0
     return max(usable // per_block, 0)
+
+
+class LlamaClientHead:
+    """The client-side ends of a Petals-style pipeline: token embedding in,
+    final RMSNorm + LM head out (Petals keeps exactly these on the client while
+    the decoder blocks run remotely). Loaded from the same HF checkpoint:
+    ``model.embed_tokens.weight``, ``model.norm.weight``, and ``lm_head.weight``
+    (absent ⇒ tied with the embedding, as Llama publishes it)."""
+
+    def __init__(self, embed: np.ndarray, norm_scale: np.ndarray, lm_head: np.ndarray,
+                 rms_eps: float = 1e-6):
+        self.embed_matrix = embed  # [vocab, hid]
+        self.norm_scale = norm_scale  # [hid]
+        self.lm_head_matrix = lm_head  # [vocab, hid]
+        self.rms_eps = rms_eps
+
+    @classmethod
+    def load(cls, checkpoint_dir) -> "LlamaClientHead":
+        reader = ShardedSafetensorsReader(checkpoint_dir)
+        config = LlamaCheckpointConfig.load(checkpoint_dir)
+        embed = reader.get("model.embed_tokens.weight").astype(np.float32)
+        norm = reader.get("model.norm.weight").astype(np.float32)
+        try:
+            lm_head = reader.get("lm_head.weight").astype(np.float32)
+        except KeyError:
+            lm_head = embed  # tied embeddings
+        return cls(embed, norm, lm_head, rms_eps=config.rms_norm_eps)
+
+    @property
+    def vocab_size(self) -> int:
+        return self.embed_matrix.shape[0]
+
+    def embed(self, token_ids: np.ndarray) -> np.ndarray:
+        """[batch, seq] int ids -> [batch, seq, hid] fp32 hidden states."""
+        return self.embed_matrix[np.asarray(token_ids, np.int64)]
+
+    def logits(self, hidden: np.ndarray) -> np.ndarray:
+        """[batch, seq, hid] block-stack output -> [batch, seq, vocab] logits
+        (RMSNorm then the LM projection, matching HF's LlamaForCausalLM tail)."""
+        hidden = np.asarray(hidden, np.float32)
+        rms = np.sqrt(np.mean(hidden**2, axis=-1, keepdims=True) + self.rms_eps)
+        normed = hidden / rms * self.norm_scale
+        return normed @ self.lm_head_matrix.T
+
+
+def generate_greedy(
+    head: LlamaClientHead,
+    pipe,
+    prompt_ids: np.ndarray,
+    max_new_tokens: int,
+    session_id: Optional[str] = None,
+) -> np.ndarray:
+    """Greedy decoding through a RemoteSequential block pipeline with KV-cache
+    sessions: one prefill RPC chain, then one single-token chain per new token
+    (the LAST token needs no trailing step — its cache entry would go unread).
+    ``session_id`` defaults to a fresh unique id: the server keys sessions
+    globally by (uid, session_id), so a shared constant would let concurrent
+    generations silently clobber each other's KV caches.
+    ``prompt_ids``: [batch, prompt_len]; returns [batch, prompt_len + new]."""
+    import uuid
+
+    if session_id is None:
+        session_id = f"gen-{uuid.uuid4().hex}"
+    ids = np.asarray(prompt_ids, np.int64)
+    hidden = pipe.decode_step(head.embed(ids), session_id, reset=True)
+    for step in range(max_new_tokens):
+        next_ids = np.argmax(head.logits(np.asarray(hidden)[:, -1:]), axis=-1)
+        ids = np.concatenate([ids, next_ids], axis=1)
+        if step + 1 < max_new_tokens:
+            hidden = pipe.decode_step(head.embed(next_ids), session_id)
+    return ids
