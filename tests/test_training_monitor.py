@@ -1,0 +1,64 @@
+"""The flagship recipe's monitor (examples/albert/run_training_monitor.py):
+joins the swarm as an observer, aggregates signed progress records, and exports
+wandb-style metrics to the offline JSONL sink (VERDICT r2 next-round #9)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import optax
+
+from hivemind_tpu.dht import DHT
+from hivemind_tpu.optim import Optimizer
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_MONITOR = os.path.join(_REPO, "examples", "albert", "run_training_monitor.py")
+
+
+def test_monitor_reports_and_exports_metrics(tmp_path):
+    dht = DHT(start=True)
+    opt = Optimizer(
+        dht=dht, run_id="monitor_test", target_batch_size=1024,
+        params={"w": np.zeros(4, np.float32)}, optimizer=optax.sgd(0.1),
+        batch_size_per_step=8, matchmaking_time=1.0,
+    )
+    monitor = None
+    try:
+        # report progress a few times so the tracker publishes signed records
+        for _ in range(5):
+            opt.step({"w": np.ones(4, np.float32)})
+            time.sleep(0.2)
+
+        sink = tmp_path / "metrics.jsonl"
+        env = dict(os.environ, PYTHONPATH=os.pathsep.join(
+            [_REPO] + [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep) if p]
+        ))
+        monitor = subprocess.Popen(
+            [sys.executable, _MONITOR, "--run_id", "monitor_test",
+             "--initial_peers", str(dht.get_visible_maddrs()[0]),
+             "--refresh_period", "1.0", "--max_reports", "2",
+             "--metrics_jsonl", str(sink)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+        )
+        # keep reporting while the monitor watches
+        deadline = time.monotonic() + 90
+        while monitor.poll() is None and time.monotonic() < deadline:
+            opt.step({"w": np.ones(4, np.float32)})
+            time.sleep(0.3)
+        out, _ = monitor.communicate(timeout=30)
+        assert monitor.returncode == 0, out[-3000:]
+
+        records = [json.loads(line) for line in sink.read_text().splitlines()]
+        assert len(records) >= 2, records
+        for record in records:
+            assert record["num_peers"] >= 1
+            assert record["samples_per_second"] >= 0
+            assert "epoch" in record and "time" in record
+    finally:
+        if monitor is not None and monitor.poll() is None:
+            monitor.kill()
+        opt.shutdown()
+        dht.shutdown()
