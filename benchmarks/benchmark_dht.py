@@ -16,6 +16,8 @@ def main():
     parser.add_argument("--num_peers", type=int, default=16)
     parser.add_argument("--num_keys", type=int, default=200)
     parser.add_argument("--expiration", type=float, default=300.0)
+    parser.add_argument("--max_connections", type=int, default=0,
+                        help="per-node connection-manager cap (bounds fds at scale; 0 = unlimited)")
     parser.add_argument("--batch_size", type=int, default=64,
                         help="keys per store_many/get_many call (reference benchmarks batch 64)")
     args = parser.parse_args()
@@ -28,9 +30,13 @@ def main():
     from hivemind_tpu.dht import DHT
     from hivemind_tpu.utils.timed_storage import get_dht_time
 
-    first = DHT(start=True)
+    p2p_opts = {"max_connections": args.max_connections} if args.max_connections else {}
+    first = DHT(start=True, **p2p_opts)
     maddrs = [str(m) for m in first.get_visible_maddrs()]
-    dhts = [first] + [DHT(initial_peers=maddrs, start=True) for _ in range(args.num_peers - 1)]
+    dhts = [first] + [
+        DHT(initial_peers=maddrs, start=True, **p2p_opts)
+        for _ in range(args.num_peers - 1)
+    ]
 
     # batched like the reference benchmark (batch 64): one store_many/get_many call
     # runs the per-key beam searches CONCURRENTLY on the node's event loop

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import struct
+import time
 from enum import IntFlag
 from typing import AsyncIterator, Awaitable, Callable, Dict, Optional
 
@@ -180,6 +181,7 @@ class MuxConnection:
         self._handler_tasks: set = set()
         self._buffered_bytes = 0
         self._max_buffered_bytes = max_buffered_bytes
+        self.last_used = time.monotonic()  # LRU key for the connection manager
 
     def _credit_bytes(self, nbytes: int) -> None:
         self._buffered_bytes -= nbytes
@@ -201,9 +203,14 @@ class MuxConnection:
         await self.send_frame(stream_id, Flags.OPEN, handler_name.encode("utf-8"))
         return stream
 
+    @property
+    def num_streams(self) -> int:
+        return len(self._streams)
+
     async def send_frame(self, stream_id: int, flags: Flags, payload: bytes) -> None:
         if self._closed:
             raise StreamClosedError(f"connection to {self.peer_id} is closed")
+        self.last_used = time.monotonic()
         try:
             await self._channel.send(_HEADER.pack(stream_id, int(flags)) + payload)
         except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
@@ -229,6 +236,7 @@ class MuxConnection:
             await self._shutdown(error)
 
     async def _dispatch(self, stream_id: int, flags: Flags, payload: bytes) -> None:
+        self.last_used = time.monotonic()
         if flags & Flags.OPEN:
             # a remote OPEN must use the REMOTE side's id parity and a fresh id: a
             # misbehaving peer reusing a local-parity or existing id would silently

@@ -112,13 +112,20 @@ class P2P:
         initial_peers: Sequence[Union[str, Multiaddr]] = (),
         dial_timeout: float = 10.0,
         relays: Sequence[str] = (),
+        max_connections: int = 0,
     ) -> "P2P":
         """``relays``: relay daemons to register at on startup (reference parity:
         p2p_daemon.py use_relay/use_auto_relay). Each spec is ``host:port`` or
         ``<relay_pubkey_hex>@host:port`` — the pinned form refuses a relay that
         cannot prove the expected Ed25519 identity over the encrypted control
         channel. Registration makes this peer dialable through the relay; failures
-        are non-fatal (logged), matching initial_peers semantics."""
+        are non-fatal (logged), matching initial_peers semantics.
+
+        ``max_connections``: connection-manager high water (reference analog:
+        go-libp2p's ConnManager inside the daemon). 0 disables. Above it, idle
+        (stream-less) connections are closed least-recently-used-first down to
+        90% of the cap; a trimmed peer is simply re-dialed on next use. This is
+        what bounds fd usage for large swarms (hundreds of DHT peers)."""
         self = object.__new__(cls)
         self._identity_lock_fd: Optional[int] = None
         if identity is None:
@@ -137,6 +144,7 @@ class P2P:
         self._bg_tasks: Set[asyncio.Task] = set()  # strong refs: loop holds tasks weakly
         self._alive_refs = 1  # P2P.replicate parity: shared instance refcount
         self._peer_resolver = None  # optional async fallback route lookup (auto-relay)
+        self._max_connections = max_connections
         self._shutting_down = False
         self._relays: list = []  # RelayClients registered via the `relays` kwarg
         self._listen_host = listen_host
@@ -297,6 +305,37 @@ class P2P:
         # so shutdown() can close them
         self._all_connections.add(conn)
         conn.start()
+        await self._trim_connections(protect=conn)
+
+    async def _trim_connections(self, protect: Optional[MuxConnection] = None) -> None:
+        """Connection manager (see ``create``): close idle LRU connections past the
+        high water mark. Never touches connections with live streams, nor relayed
+        circuits (their route may not be re-dialable without the relay client
+        that created them)."""
+        if not self._max_connections:
+            return
+        self._prune_dead_connections()  # dead entries must not count toward the marks
+        if len(self._all_connections) <= self._max_connections:
+            return
+        low_water = max(int(self._max_connections * 0.9), 1)
+        idle = sorted(
+            (
+                conn
+                for conn in self._all_connections
+                if conn is not protect
+                and not conn.is_closed
+                and conn.num_streams == 0
+                and not getattr(conn, "is_relayed", False)
+            ),
+            key=lambda conn: conn.last_used,
+        )
+        for conn in idle:
+            if len(self._all_connections) <= low_water:
+                break
+            await conn.close()
+            self._all_connections.discard(conn)
+            if self._connections.get(conn.peer_id) is conn:
+                del self._connections[conn.peer_id]
 
     def _register_peer_addrs(self, peer_id: PeerID, addrs) -> None:
         store = self._peerstore.setdefault(peer_id, set())
@@ -353,6 +392,7 @@ class P2P:
         self._connections[peer_id] = conn
         self._all_connections.add(conn)
         conn.start()
+        await self._trim_connections(protect=conn)
         return conn
 
     def _close_after_grace(self, conn: MuxConnection, grace: float = 30.0) -> None:
@@ -471,6 +511,18 @@ class P2P:
 
     # ------------------------------------------------------------------ calls
 
+    async def _open_stream_with_redial(self, peer_id: PeerID, name: str) -> MuxStream:
+        """Open a stream, re-dialing once if the cached connection died between
+        lookup and use (e.g. the connection manager trimmed it, or the peer
+        restarted) — a trimmed idle connection must look like a cache miss, not
+        an RPC failure."""
+        conn = await self._get_connection(peer_id)
+        try:
+            return await conn.open_stream(name)
+        except StreamClosedError:
+            conn = await self._get_connection(peer_id)
+            return await conn.open_stream(name)
+
     async def call_protobuf_handler(
         self,
         peer_id: PeerID,
@@ -479,20 +531,27 @@ class P2P:
         response_type: Optional[Type] = None,
     ):
         """Unary call: one request, one response."""
-        conn = await self._get_connection(peer_id)
-        stream = await conn.open_stream(name)
-        try:
-            await stream.send(_serialize(request))
-            await stream.close_send()
+        for attempt in range(2):
+            stream = await self._open_stream_with_redial(peer_id, name)
             try:
-                response = await stream.receive()
-            except RemoteError as e:
-                raise P2PHandlerError(str(e)) from e
-            except StreamClosedError:
-                raise P2PHandlerError(f"{name}: stream closed before response") from None
-            return _parse(response, response_type)
-        finally:
-            await stream.reset()
+                await stream.send(_serialize(request))
+                await stream.close_send()
+                try:
+                    response = await stream.receive()
+                except RemoteError as e:
+                    raise P2PHandlerError(str(e)) from e
+                except StreamClosedError:
+                    # nothing was received: the connection most likely died under
+                    # us (e.g. the PEER's connection manager trimmed it while we
+                    # were opening the stream — its read loop is already gone, so
+                    # the request was dropped unprocessed). One fresh-connection
+                    # retry turns that race into a cache miss instead of an error.
+                    if attempt == 0 and stream._conn.is_closed:
+                        continue
+                    raise P2PHandlerError(f"{name}: stream closed before response") from None
+                return _parse(response, response_type)
+            finally:
+                await stream.reset()
 
     async def iterate_protobuf_handler(
         self,
@@ -503,8 +562,7 @@ class P2P:
     ) -> AsyncIterator:
         """Streaming call: ``requests`` is one message or an async iterator of them;
         yields response messages until the remote closes."""
-        conn = await self._get_connection(peer_id)
-        stream = await conn.open_stream(name)
+        stream = await self._open_stream_with_redial(peer_id, name)
 
         async def _feed():
             try:

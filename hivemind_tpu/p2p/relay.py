@@ -283,11 +283,16 @@ class RelayClient:
         conn = MuxConnection(
             noise_channel, peer_id, is_initiator=True, on_inbound_stream=self.p2p._route_stream
         )
+        # circuits are exempt from connection-manager trimming (the plain dial
+        # path cannot re-establish them), but they must still TRIGGER a trim so
+        # relay-heavy nodes respect the fd bound via their direct connections
+        conn.is_relayed = True
         existing = self.p2p._connections.get(peer_id)
         if existing is None or existing.is_closed:
             self.p2p._connections[peer_id] = conn
         self.p2p._all_connections.add(conn)
         conn.start()
+        await self.p2p._trim_connections(protect=conn)
         return peer_id
 
     async def whoami(self) -> Tuple[str, int]:

@@ -383,3 +383,35 @@ async def test_identity_file_readonly_and_failed_create(tmp_path):
     assert retry.peer_id == node.peer_id
     await retry.shutdown()
     await blocker.shutdown()
+
+
+async def test_connection_manager_trims_idle_and_redials():
+    """Reference parity (go-libp2p ConnManager): past the high water mark, idle
+    stream-less connections close LRU-first; a trimmed peer is re-dialed
+    transparently on the next call — this is what bounds fd usage at swarm scale."""
+    hub = await P2P.create(max_connections=4)
+
+    async def echo(request: test_pb2.TestRequest, context: P2PContext) -> test_pb2.TestResponse:
+        return test_pb2.TestResponse(number=request.number + 1)
+
+    await hub.add_protobuf_handler("echo", echo, test_pb2.TestRequest)
+    spokes = []
+    try:
+        for _ in range(8):
+            spoke = await P2P.create()
+            await spoke.connect(hub.get_visible_maddrs()[0])
+            spokes.append(spoke)
+        await asyncio.sleep(0.1)
+        live = [c for c in hub._all_connections if not c.is_closed]
+        assert len(live) <= 4, f"{len(live)} live connections past the cap"
+
+        # every spoke can still call the hub: trimmed ones re-dial transparently
+        for i, spoke in enumerate(spokes):
+            response = await spoke.call_protobuf_handler(
+                hub.peer_id, "echo", test_pb2.TestRequest(number=i), test_pb2.TestResponse
+            )
+            assert response.number == i + 1
+    finally:
+        for spoke in spokes:
+            await spoke.shutdown()
+        await hub.shutdown()
