@@ -21,6 +21,9 @@ def main():
     parser.add_argument("--announce_host", default=None, help="externally visible host")
     parser.add_argument("--identity_path", default=None, help="persistent identity file")
     parser.add_argument("--refresh_period", type=float, default=30.0, help="health report interval")
+    parser.add_argument("--max_connections", type=int, default=0,
+                        help="connection-manager high water (0 = unlimited): idle "
+                             "LRU connections close past it, bounding fds at scale")
     from hivemind_tpu.utils.platform import add_platform_arg, apply_platform
 
     add_platform_arg(parser)
@@ -34,6 +37,7 @@ def main():
         listen_port=args.listen_port,
         announce_host=args.announce_host,
         identity_path=args.identity_path,
+        max_connections=args.max_connections,
     )
     for maddr in dht.get_visible_maddrs():
         logger.info(f"listening: {maddr}")

@@ -1,0 +1,114 @@
+"""Run the native relay daemon AND advertise it in the swarm's DHT — the complete
+relay-operator story for zero-config auto-relay (reference role: public peers
+with relay enabled, p2p_daemon.py use_relay; here the relay is the C++ daemon
+`hivemind_tpu/native/relay_daemon.cpp` and discovery rides `p2p/autorelay.py`).
+
+    python -m hivemind_tpu.hivemind_cli.run_relay \
+        --initial_peers /ip4/…/tcp/…/p2p/Qm… \
+        --relay_port 34000 --announce_host 203.0.113.7
+
+NATed peers then find this relay via `AutoRelay.create(p2p, dht)` with zero
+relay configuration."""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import time
+from pathlib import Path
+
+from hivemind_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+NATIVE_DIR = Path(__file__).parent.parent / "native"
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Run + advertise a relay daemon")
+    parser.add_argument("--initial_peers", nargs="*", default=[],
+                        help="DHT bootstrap addrs (empty: starts a fresh swarm)")
+    parser.add_argument("--relay_port", type=int, default=0, help="0 = ephemeral")
+    parser.add_argument("--announce_host", default=None,
+                        help="the relay endpoint advertised to the swarm (REQUIRED "
+                             "for real deployments; defaults to loopback for local "
+                             "testing only)")
+    parser.add_argument("--identity_path", default="relay.key",
+                        help="persistent relay Ed25519 identity file")
+    parser.add_argument("--advertise_period", type=float, default=300.0,
+                        help="re-advertise at this period (records expire at 2x)")
+    from hivemind_tpu.utils.platform import add_platform_arg, apply_platform
+
+    add_platform_arg(parser)
+    args = parser.parse_args()
+    apply_platform(args)
+
+    if args.announce_host is None:
+        args.announce_host = "127.0.0.1"
+        logger.warning(
+            "no --announce_host given: advertising LOOPBACK (127.0.0.1) — fine for "
+            "local testing, useless to any peer on another machine"
+        )
+
+    binary = NATIVE_DIR / "relay_daemon"
+    if not binary.exists():
+        logger.info("building the relay daemon (first run)")
+        build = subprocess.run(["make"], cwd=NATIVE_DIR, capture_output=True, text=True)
+        if build.returncode != 0:
+            raise RuntimeError(f"relay daemon build failed:\n{build.stderr[-2000:]}")
+
+    daemon = subprocess.Popen(
+        [str(binary), str(args.relay_port), args.identity_path],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    first_line = daemon.stdout.readline().strip()
+    if not first_line:  # daemon died before announcing (e.g. port already bound)
+        daemon.wait(timeout=5)
+        raise RuntimeError(
+            f"relay daemon exited at startup (rc={daemon.returncode}): "
+            f"{daemon.stderr.read()[-500:]}"
+        )
+    port = int(first_line.rsplit(" ", 1)[-1])
+    # the identity line only appears when the daemon has crypto; don't block on it
+    import select
+
+    ready, _, _ = select.select([daemon.stdout], [], [], 2.0)
+    identity_line = daemon.stdout.readline().strip() if ready else ""
+    pubkey_hex = identity_line.rsplit(" ", 1)[-1] if "identity" in identity_line else ""
+    if pubkey_hex:
+        logger.info(f"relay daemon up on port {port} (identity {pubkey_hex[:16]}…)")
+    else:
+        logger.warning(
+            f"relay daemon up on port {port} WITHOUT an identity (no libcrypto?) — "
+            f"peers cannot pin it and will refuse encrypted-control registration"
+        )
+
+    from hivemind_tpu.dht import DHT
+    from hivemind_tpu.p2p.autorelay import advertise_relay
+
+    dht = DHT(initial_peers=args.initial_peers, start=True)
+    for maddr in dht.get_visible_maddrs():
+        logger.info(f"swarm members can bootstrap via: --initial_peers {maddr}")
+
+    try:
+        while True:
+            if daemon.poll() is not None:
+                raise RuntimeError(f"relay daemon exited with rc={daemon.returncode}")
+            ok = advertise_relay(
+                dht, args.announce_host, port, pubkey_hex, ttl=args.advertise_period * 2
+            )
+            logger.info(
+                f"advertised {args.announce_host}:{port} in the DHT (stored={ok}); "
+                f"next refresh in {args.advertise_period:.0f}s"
+            )
+            time.sleep(args.advertise_period)
+    except KeyboardInterrupt:
+        logger.info("shutting down")
+    finally:
+        daemon.kill()
+        daemon.wait()
+        dht.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -50,6 +50,9 @@ def main():
                         help="concurrent decode sessions the HBM plan reserves "
                              "KV-cache space for")
     parser.add_argument("--learning_rate", type=float, default=1e-3)
+    parser.add_argument("--max_connections", type=int, default=0,
+                        help="connection-manager high water for the DHT peer "
+                             "(0 = unlimited; bounds fds at swarm scale)")
     parser.add_argument("--increase_file_limit", action="store_true",
                         help="raise RLIMIT_NOFILE for many concurrent connections")
     from hivemind_tpu.utils.platform import add_platform_arg, apply_platform
@@ -82,6 +85,11 @@ def main():
         _run_forever(server)
         return
 
+    from hivemind_tpu.dht import DHT
+
+    # construct the DHT here so --max_connections reaches its transport
+    dht = DHT(initial_peers=args.initial_peers, start=True,
+              max_connections=args.max_connections)
     server = Server.create(
         num_experts=args.num_experts,
         expert_uids=args.expert_uids,
@@ -90,7 +98,7 @@ def main():
         hidden_dim=args.hidden_dim,
         expert_kwargs=json.loads(args.expert_kwargs) if args.expert_kwargs else None,
         max_batch_size=args.max_batch_size,
-        initial_peers=args.initial_peers,
+        dht=dht,
         checkpoint_dir=Path(args.checkpoint_dir) if args.checkpoint_dir else None,
         decode_max_len=args.decode_max_len,
         optim_factory=lambda: optax.adam(args.learning_rate),
@@ -147,7 +155,8 @@ def _serve_llama_checkpoint(args) -> Server:
         weight_quantization=args.weight_quantization,
         max_batch_size=args.max_batch_size,
     )
-    dht = DHT(initial_peers=args.initial_peers, start=True)
+    dht = DHT(initial_peers=args.initial_peers, start=True,
+              max_connections=args.max_connections)
     server = Server(
         dht, backends, decode_max_len=args.decode_max_len,
         # the HBM plan reserved KV space for exactly this many sessions: cap the
