@@ -302,3 +302,57 @@ def test_greedy_generation_from_checkpoint_over_rpc(tmp_path):
             client_dht.shutdown()
         server.shutdown()
         dht.shutdown()
+
+
+def test_generation_across_two_servers(tmp_path):
+    """The multi-server BASELINE #5 topology: each server hosts a layer RANGE of
+    the same checkpoint (quickstart's --llama_layers story); the client chains
+    them by uid and generates across both."""
+    from safetensors.numpy import save_file
+
+    from hivemind_tpu.moe import RemoteSequential
+    from hivemind_tpu.moe.server.llama_loader import LlamaClientHead, generate_greedy
+
+    VOCAB = 64
+    _write_checkpoint(tmp_path)
+    rng = np.random.RandomState(33)
+    head_tensors = {
+        "model.embed_tokens.weight": (rng.randn(VOCAB, HID) / np.sqrt(HID)).astype(np.float32),
+        "model.norm.weight": np.ones(HID, np.float32),
+    }
+    save_file(head_tensors, tmp_path / "model-head.safetensors")
+    index_path = tmp_path / "model.safetensors.index.json"
+    index = json.loads(index_path.read_text())
+    index["weight_map"].update({n: "model-head.safetensors" for n in head_tensors})
+    index_path.write_text(json.dumps(index))
+
+    backends_a, _config = load_llama_blocks(tmp_path, layers=[0], uid_prefix="sp.")
+    backends_b, _config = load_llama_blocks(
+        tmp_path, layers=[1], uid_prefix="sp.", weight_quantization="int8"
+    )
+    dht_a = DHT(start=True)
+    server_a = Server(dht_a, backends_a, decode_max_len=64)
+    dht_b = DHT(initial_peers=[str(m) for m in dht_a.get_visible_maddrs()], start=True)
+    server_b = Server(dht_b, backends_b, decode_max_len=64)
+    client_dht = None
+    try:
+        server_a.run_in_background(await_ready=True)
+        server_b.run_in_background(await_ready=True)
+        time.sleep(1.0)
+        client_dht = DHT(initial_peers=[str(m) for m in dht_a.get_visible_maddrs()], start=True)
+        pipe = RemoteSequential(client_dht, "sp.", LAYERS)
+        head = LlamaClientHead.load(tmp_path)
+        assert np.array_equal(head.lm_head_matrix, head.embed_matrix)  # tied fallback
+
+        prompt = rng.randint(0, VOCAB, size=(1, 4))
+        generated = generate_greedy(head, pipe, prompt, max_new_tokens=5)
+        assert generated.shape == (1, 9)
+        assert np.array_equal(generated[:, :4], prompt)
+        assert (generated >= 0).all() and (generated < VOCAB).all()
+    finally:
+        if client_dht is not None:
+            client_dht.shutdown()
+        server_b.shutdown()
+        server_a.shutdown()
+        dht_b.shutdown()
+        dht_a.shutdown()
