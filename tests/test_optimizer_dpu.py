@@ -282,3 +282,65 @@ def test_local_updates_with_delayed_state_averaging():
     finally:
         for dht in dhts:
             dht.shutdown()
+
+
+def test_powersgd_with_dpu_convergence():
+    """The recipe's two throughput flags COMBINED: PowerSGD low-rank gradient
+    compression inside Delayed Parameter Updates — compressed chained-phase
+    averaging rounds run on the background thread while training continues."""
+    from hivemind_tpu.optim import PowerSGDGradientAverager
+
+    features, targets, loss_and_grad = _toy_problem()
+    dhts = launch_dht_swarm(2)
+    results, errors = {}, []
+
+    def run_peer(index: int, dht: DHT):
+        try:
+            # w as a matrix so PowerSGD actually compresses (vectors pass raw)
+            params = {"w": jnp.zeros((8, 1), jnp.float32)}
+            opt = Optimizer(
+                dht=dht, run_id="psgd_dpu_test", target_batch_size=64,
+                params=params, optimizer=optax.sgd(0.3),
+                batch_size_per_step=16, matchmaking_time=1.5, averaging_timeout=30,
+                average_state_every=1, target_group_size=2,
+                delay_optimizer_step=True, delta_rule_averaging=True,
+                grad_averager_factory=PowerSGDGradientAverager,
+                grad_averager_opts={"averager_rank": 4},
+                tracker_opts=dict(min_refresh_period=0.3, default_refresh_period=0.5),
+            )
+            rng_local = np.random.RandomState(index)
+            first_loss = last_loss = None
+            for _ in range(80):
+                if opt.local_epoch >= 4:
+                    break
+                idx = rng_local.choice(len(features), 16)
+                loss, grads = loss_and_grad(
+                    {"w": opt.params["w"][:, 0]}, features[idx], targets[idx]
+                )
+                first_loss = first_loss if first_loss is not None else float(loss)
+                last_loss = float(loss)
+                opt.step({"w": grads["w"][:, None]})
+                time.sleep(0.25)
+            results[index] = (first_loss, last_loss, opt.local_epoch)
+            opt.shutdown()
+        except Exception as e:
+            import traceback
+
+            errors.append((index, e, traceback.format_exc()))
+
+    threads = [threading.Thread(target=run_peer, args=(i, d)) for i, d in enumerate(dhts)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=240)
+    try:
+        assert not errors, f"peer failures: {errors}"
+        assert len(results) == 2
+        for index, (first_loss, last_loss, epoch) in results.items():
+            assert epoch >= 2, f"peer {index} stuck at epoch {epoch}"
+            assert last_loss < first_loss / 5, (
+                f"peer {index}: loss {first_loss:.4f} -> {last_loss:.4f} did not converge"
+            )
+    finally:
+        for dht in dhts:
+            dht.shutdown()
