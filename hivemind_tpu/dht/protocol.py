@@ -37,6 +37,11 @@ class DHTProtocol(ServicerBase):
     carries sender NodeInfo and updates the receiver's routing table; new routing-table
     entries trigger handoff of local keys that are closer to the newcomer."""
 
+    # ping/find are reads; store has set semantics (storing the same record twice
+    # yields the same state), so all three are safe to retry on an ambiguous
+    # connection loss (see P2P.call_protobuf_handler idempotency gate)
+    _idempotent_rpcs = frozenset({"rpc_ping", "rpc_find", "rpc_store"})
+
     @classmethod
     async def create(
         cls,

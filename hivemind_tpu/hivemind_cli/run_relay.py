@@ -51,11 +51,14 @@ def main():
         )
 
     binary = NATIVE_DIR / "relay_daemon"
-    if not binary.exists():
-        logger.info("building the relay daemon (first run)")
+    if (NATIVE_DIR / "relay_daemon.cpp").exists():
+        # make's own dependency rule handles staleness (no-op when fresh); a stale
+        # binary could predate the two-startup-line protocol parsed below
         build = subprocess.run(["make"], cwd=NATIVE_DIR, capture_output=True, text=True)
         if build.returncode != 0:
             raise RuntimeError(f"relay daemon build failed:\n{build.stderr[-2000:]}")
+    elif not binary.exists():
+        raise RuntimeError(f"no relay daemon binary or source under {NATIVE_DIR}")
 
     daemon = subprocess.Popen(
         [str(binary), str(args.relay_port), args.identity_path],
@@ -68,20 +71,29 @@ def main():
             f"relay daemon exited at startup (rc={daemon.returncode}): "
             f"{daemon.stderr.read()[-500:]}"
         )
-    port = int(first_line.rsplit(" ", 1)[-1])
-    # the identity line only appears when the daemon has crypto; don't block on it
-    import select
-
-    ready, _, _ = select.select([daemon.stdout], [], [], 2.0)
-    identity_line = daemon.stdout.readline().strip() if ready else ""
-    pubkey_hex = identity_line.rsplit(" ", 1)[-1] if "identity" in identity_line else ""
-    if pubkey_hex:
+    try:
+        port = int(first_line.rsplit(" ", 1)[-1])
+    except ValueError:
+        daemon.kill()
+        raise RuntimeError(f"unexpected relay daemon output: {first_line!r}") from None
+    # the daemon emits exactly two startup lines in one flush ("relay identity
+    # <hex>" or "relay encryption unavailable"), so a blocking readline cannot
+    # race the stream buffer; anything else is an error — a crypto-capable relay
+    # advertised WITHOUT its identity would silently downgrade every NATed peer
+    # to unpinned registration
+    identity_line = daemon.stdout.readline().strip()
+    if identity_line.startswith("relay identity "):
+        pubkey_hex = identity_line.rsplit(" ", 1)[-1]
         logger.info(f"relay daemon up on port {port} (identity {pubkey_hex[:16]}…)")
-    else:
+    elif identity_line == "relay encryption unavailable":
+        pubkey_hex = ""
         logger.warning(
-            f"relay daemon up on port {port} WITHOUT an identity (no libcrypto?) — "
+            f"relay daemon up on port {port} WITHOUT an identity (no libcrypto) — "
             f"peers cannot pin it and will refuse encrypted-control registration"
         )
+    else:
+        daemon.kill()
+        raise RuntimeError(f"unexpected relay daemon output: {identity_line!r}")
 
     from hivemind_tpu.dht import DHT
     from hivemind_tpu.p2p.autorelay import advertise_relay

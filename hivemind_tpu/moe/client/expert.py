@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from hivemind_tpu.compression import deserialize_tensor, serialize_tensor, split_tensor_for_streaming
-from hivemind_tpu.moe.expert_uid import ExpertInfo
+from hivemind_tpu.moe.expert_uid import IDEMPOTENT_CONNECTION_RPCS, ExpertInfo
 from hivemind_tpu.p2p import P2P, PeerID
 from hivemind_tpu.proto import runtime_pb2
 from hivemind_tpu.utils.logging import get_logger
@@ -68,6 +68,7 @@ class RemoteExpert:
                         "ConnectionHandler.rpc_info",
                         runtime_pb2.ExpertUID(uid=self.uid),
                         runtime_pb2.ExpertInfoResponse,
+                        idempotent=True,
                     )
                 )
                 self._info = MSGPackSerializer.loads(response.serialized_info)
@@ -86,6 +87,7 @@ class RemoteExpert:
                 f"ConnectionHandler.rpc_{method}",
                 runtime_pb2.ExpertRequest(uid=self.uid, tensors=serialized, metadata=metadata),
                 runtime_pb2.ExpertResponse,
+                idempotent=(f"rpc_{method}" in IDEMPOTENT_CONNECTION_RPCS),
             )
             return [deserialize_tensor(t) for t in response.tensors]
         # streaming path for big payloads (metadata rides the first message)

@@ -15,6 +15,14 @@ ExpertPrefix = str
 
 UID_DELIMITER = "."
 FLAT_EXPERT = -1
+
+# The client/server contract for retry safety on ambiguous connection loss:
+# rpc_info is a pure read and rpc_forward is side-effect-free (inference only);
+# rpc_backward (steps the expert optimizer) and rpc_decode (advances a KV-cache
+# session) must fail loudly instead of risking a double-applied side effect.
+# Single source of truth for ConnectionHandler._idempotent_rpcs AND the direct
+# call sites in client/expert.py.
+IDEMPOTENT_CONNECTION_RPCS = frozenset({"rpc_info", "rpc_forward"})
 UID_PATTERN = re.compile(r"^(([^.])+)([.](?:[0]|([1-9]([0-9]*))))+$")
 PREFIX_PATTERN = re.compile(r"^(([^.])+)([.](?:[0]|([1-9]([0-9]*))))*[.]$")
 

@@ -15,6 +15,7 @@ from hivemind_tpu.compression import (
     serialize_tensor,
     split_tensor_for_streaming,
 )
+from hivemind_tpu.moe.expert_uid import IDEMPOTENT_CONNECTION_RPCS
 from hivemind_tpu.moe.server.module_backend import ModuleBackend
 from hivemind_tpu.moe.server.task_pool import TaskPool
 from hivemind_tpu.p2p import P2P, P2PContext, ServicerBase
@@ -28,6 +29,10 @@ _STREAM_CHUNK = 2**20  # 1 MiB chunks inside stream replies
 
 
 class ConnectionHandler(ServicerBase):
+    # which RPCs may be retried on ambiguous connection loss — shared with the
+    # client's direct call sites (expert.py), see expert_uid.py for the rationale
+    _idempotent_rpcs = IDEMPOTENT_CONNECTION_RPCS
+
     def __init__(self, backends: Dict[str, ModuleBackend], decode_max_len: int = 256,
                  decode_max_sessions: int = 64):
         from hivemind_tpu.moe.server.decode_session import DecodeSessionManager

@@ -249,31 +249,50 @@ class DecodeSessionManager:
                 seen.add(id(entry[1]))
                 batch_entries.append(entry)
         try:
-            results = await loop.run_in_executor(None, self._decode_batch, uid, batch_entries)
-            for (future, _session, _x), result in zip(batch_entries, results):
-                if not future.done():
-                    if isinstance(result, Exception):
-                        future.set_exception(result)
-                    else:
-                        future.set_result(result)
-        except Exception as e:
-            for future, _session, _x in batch_entries:
-                if not future.done():
-                    future.set_exception(e)
-        # steps that arrived WHILE the batch was computing (decode_async saw a live
-        # drainer and only enqueued) — and any same-session rollover — need a fresh
-        # drainer now, or they would strand until some future call happens to spawn one
-        with self._lock:
-            for _future, session, _x in entries:
-                count = self._in_flight.get(id(session), 0) - 1
-                if count > 0:
-                    self._in_flight[id(session)] = count
+            error = None
+            try:
+                results = await loop.run_in_executor(None, self._decode_batch, uid, batch_entries)
+            except Exception as e:
+                error = e
+            for i, (future, _session, _x) in enumerate(batch_entries):
+                if future.done():
+                    continue
+                result = error if error is not None else results[i]
+                if isinstance(result, Exception):
+                    future.set_exception(result)
                 else:
-                    self._in_flight.pop(id(session), None)
-            if rollover:
-                self._pending.setdefault(uid, []).extend(rollover)
-            if self._pending.get(uid):
-                self._drainers[uid] = loop.create_task(self._drain(uid))
+                    future.set_result(result)
+            # steps that arrived WHILE the batch was computing (decode_async saw a
+            # live drainer and only enqueued) — and any same-session rollover — need
+            # a fresh drainer now, or they would strand until some future call
+            # happens to spawn one
+            with self._lock:
+                if rollover:
+                    self._pending.setdefault(uid, []).extend(rollover)
+                if self._pending.get(uid):
+                    self._drainers[uid] = loop.create_task(self._drain(uid))
+        except asyncio.CancelledError:
+            # drainer killed mid-batch (loop shutdown, server stop): nothing will
+            # ever resolve these futures or re-drain the rollover — cancel them so
+            # callers unblock instead of waiting forever. Steps that arrived WHILE
+            # the batch was computing only enqueued into _pending (they saw a live
+            # drainer), so they must be swept too or they strand and pin forever.
+            with self._lock:
+                stranded = self._pending.pop(uid, [])
+            for future, _session, _x in batch_entries + rollover + stranded:
+                if not future.done():
+                    future.cancel()
+            raise
+        finally:
+            # the eviction pins MUST drop on every exit path: a leaked pin makes the
+            # session permanently unevictable
+            with self._lock:
+                for _future, session, _x in entries:
+                    count = self._in_flight.get(id(session), 0) - 1
+                    if count > 0:
+                        self._in_flight[id(session)] = count
+                    else:
+                        self._in_flight.pop(id(session), None)
 
     def _batched_fn(self, uid: str, stack: int):
         key = (uid, stack)

@@ -734,6 +734,10 @@ int main(int argc, char** argv) {
     char hex[65];
     for (int i = 0; i < 32; i++) snprintf(hex + 2 * i, 3, "%02x", g_relay_pub[i]);
     printf("relay identity %s\n", hex);
+  } else {
+    // exactly two startup lines in EVERY build, emitted in one flush: launchers
+    // can block-read both instead of racing a buffered stream with select()
+    printf("relay encryption unavailable\n");
   }
   fflush(stdout);
 

@@ -108,6 +108,7 @@ class SecureChannel:
         self._writer_task: Optional[asyncio.Task] = None
         self._recv_queue: asyncio.Queue = asyncio.Queue(maxsize=_RECV_PREFETCH)
         self._recv_error: Optional[BaseException] = None
+        self._recv_stopped = False  # auth failure: no frame past it may be delivered
         self._reader_task: Optional[asyncio.Task] = None
         self._closed = False
 
@@ -182,15 +183,55 @@ class SecureChannel:
         if self._reader_task is None:
             self._reader_task = asyncio.create_task(self._reader_loop())
         while True:
-            if self._recv_error is not None and self._recv_queue.empty():
+            if self._recv_stopped or (self._recv_error is not None and self._recv_queue.empty()):
                 raise self._recv_error
             opened = await self._recv_queue.get()
             if opened is None:  # reader loop ended; the stored error says why
+                # one sentinel must serve EVERY concurrent recv(): re-enqueue it so
+                # a second parked waiter wakes and raises too instead of hanging
+                with contextlib.suppress(asyncio.QueueFull):
+                    self._recv_queue.put_nowait(None)
+                if self._recv_error is not None:
+                    raise self._recv_error
                 continue
             try:
                 return (await opened) if asyncio.isfuture(opened) else opened
+            except HandshakeError:
+                # the prefetch queue is FIFO, so frames behind the tampered one sit
+                # behind this failure: stop delivery for good (a clean reader death
+                # still drains prefetched VALID frames — only auth failure stops)
+                self._recv_stopped = True
+                raise
             except InvalidTag:
-                raise HandshakeError("AEAD authentication failed (corrupted or replayed frame)")
+                # defensive: _open_offloaded normally converts + poisons already
+                error = HandshakeError("AEAD authentication failed (corrupted or replayed frame)")
+                self._recv_stopped = True
+                self._poison(error)
+                raise error
+
+    def _poison(self, error: BaseException) -> None:
+        """Fatal receive-side failure: kill BOTH directions and stop the reader.
+        Authentication failure must be fatal regardless of frame size — nonces are
+        counters, so if the channel survived one InvalidTag, later frames would
+        still authenticate and an on-path attacker could selectively delete a
+        frame by corrupting it."""
+        if self._recv_error is None:
+            self._recv_error = error
+        self._fail_send(error)
+        if self._reader_task is not None and not self._reader_task.done():
+            self._reader_task.cancel()
+        if self._writer_task is not None:
+            self._send_queue.put_nowait(None)
+        with contextlib.suppress(asyncio.QueueFull):
+            self._recv_queue.put_nowait(None)
+
+    async def _open_offloaded(self, future: "asyncio.Future[bytes]") -> bytes:
+        try:
+            return await future
+        except InvalidTag:
+            error = HandshakeError("AEAD authentication failed (corrupted or replayed frame)")
+            self._poison(error)
+            raise error from None
 
     async def _reader_loop(self) -> None:
         error: BaseException
@@ -205,9 +246,19 @@ class SecureChannel:
                 self._recv_counter += 1
                 executor = _get_aead_executor()
                 if executor is not None and length >= _OFFLOAD_THRESHOLD:
-                    opened = asyncio.get_running_loop().run_in_executor(
-                        executor, self._recv_aead.decrypt, nonce, ciphertext, None
+                    # wrap the executor future so an InvalidTag poisons the channel
+                    # the moment the decrypt finishes — even if recv() never awaits
+                    # this particular frame
+                    opened = asyncio.ensure_future(
+                        self._open_offloaded(
+                            asyncio.get_running_loop().run_in_executor(
+                                executor, self._recv_aead.decrypt, nonce, ciphertext, None
+                            )
+                        )
                     )
+                    # mark a never-awaited failure as retrieved (recv may have
+                    # already raised on an earlier frame and stopped consuming)
+                    opened.add_done_callback(lambda t: t.cancelled() or t.exception())
                 else:
                     try:
                         opened = self._recv_aead.decrypt(nonce, ciphertext, None)
@@ -220,7 +271,8 @@ class SecureChannel:
             raise
         except BaseException as e:
             error = e
-        self._recv_error = error
+        if self._recv_error is None:  # don't overwrite an earlier poison error
+            self._recv_error = error
         # a dead connection must also stop the writer (it may be parked on its queue)
         self._fail_send(error)
         if self._writer_task is not None:

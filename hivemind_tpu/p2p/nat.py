@@ -72,7 +72,7 @@ class NATTraversal:
         register at a relay (reference auto_relay, p2p_daemon.py:126-137)."""
         maddrs = maddrs if maddrs is not None else self.p2p.get_visible_maddrs()
         request = MSGPackSerializer.dumps([str(m) for m in maddrs])
-        response = await self.p2p.call_protobuf_handler(via, "nat.check", request)
+        response = await self.p2p.call_protobuf_handler(via, "nat.check", request, idempotent=True)
         return list(MSGPackSerializer.loads(response))
 
     # ------------------------------------------------------------------ hole punching

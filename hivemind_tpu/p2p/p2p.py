@@ -529,26 +529,46 @@ class P2P:
         name: str,
         request,
         response_type: Optional[Type] = None,
+        *,
+        idempotent: bool = False,
     ):
-        """Unary call: one request, one response."""
+        """Unary call: one request, one response.
+
+        A failure while opening the stream or sending the request provably precedes
+        delivery, so it is always retried once on a fresh connection (the LRU trim /
+        peer-restart race). A failure while *waiting for the response* does not prove
+        the handler never ran — the connection can die after the handler executed but
+        before the response arrived — so that retry is gated on ``idempotent``:
+        side-effectful calls (rpc_backward, rpc_decode) must fail loudly rather than
+        risk double-applying an optimizer step or double-advancing a KV cache.
+        """
+        payload = _serialize(request)
         for attempt in range(2):
             stream = await self._open_stream_with_redial(peer_id, name)
             try:
-                await stream.send(_serialize(request))
-                await stream.close_send()
+                try:
+                    await stream.send(payload)
+                    await stream.close_send()
+                except StreamClosedError:
+                    # the request never left: safe to retry for any RPC
+                    if attempt == 0:
+                        continue
+                    raise P2PHandlerError(f"{name}: connection closed before request was sent") from None
                 try:
                     response = await stream.receive()
                 except RemoteError as e:
                     raise P2PHandlerError(str(e)) from e
                 except StreamClosedError:
-                    # nothing was received: the connection most likely died under
-                    # us (e.g. the PEER's connection manager trimmed it while we
-                    # were opening the stream — its read loop is already gone, so
-                    # the request was dropped unprocessed). One fresh-connection
-                    # retry turns that race into a cache miss instead of an error.
-                    if attempt == 0 and stream._conn.is_closed:
+                    # nothing was received, but the request WAS sent: the peer may
+                    # or may not have processed it. Only retry when the caller
+                    # declared the RPC idempotent (reads: rpc_info, DHT ping/find,
+                    # or set-semantics writes like rpc_store).
+                    if idempotent and attempt == 0 and stream._conn.is_closed:
                         continue
-                    raise P2PHandlerError(f"{name}: stream closed before response") from None
+                    raise P2PHandlerError(
+                        f"{name}: stream closed before response"
+                        + ("" if idempotent else " (not retried: RPC not marked idempotent)")
+                    ) from None
                 return _parse(response, response_type)
             finally:
                 await stream.reset()

@@ -24,6 +24,7 @@ class _RPCSpec:
     response_type: Type
     stream_input: bool
     stream_output: bool
+    idempotent: bool = False
 
 
 import collections.abc
@@ -79,7 +80,11 @@ class ServicerBase:
             request_type, stream_input = _unwrap_iterator(hints[request_param])
             response_type, stream_output = _unwrap_iterator(hints.get("return"))
             assert response_type is not None, f"{cls.__name__}.{name} must annotate its return type"
-            specs.append(_RPCSpec(name, request_type, response_type, stream_input, stream_output))
+            # subclasses whitelist safe-to-retry RPCs (reads or set-semantics writes)
+            # via ``_idempotent_rpcs``; everything else fails loudly on an ambiguous
+            # connection loss instead of risking a double-applied side effect
+            idempotent = name in getattr(cls, "_idempotent_rpcs", frozenset())
+            specs.append(_RPCSpec(name, request_type, response_type, stream_input, stream_output, idempotent))
         cls._rpc_specs = specs
         return specs
 
@@ -158,7 +163,9 @@ class ServicerBase:
                     result = item
                 return result
             return await asyncio.wait_for(
-                self._p2p.call_protobuf_handler(self._peer_id, name, request, spec.response_type),
+                self._p2p.call_protobuf_handler(
+                    self._peer_id, name, request, spec.response_type, idempotent=spec.idempotent
+                ),
                 timeout=timeout,
             )
 

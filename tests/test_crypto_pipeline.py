@@ -154,3 +154,71 @@ def test_corrupted_frame_fails_authentication(monkeypatch):
         await server.wait_closed()
 
     asyncio.run(scenario())
+
+
+def test_tampered_large_frame_poisons_whole_channel(monkeypatch):
+    """AEAD failure must be fatal regardless of frame size (round-3 advisor,
+    crypto_channel.py:191): nonces are counters, so if one tampered OFFLOADED frame
+    only killed its own recv(), later frames would still authenticate and an
+    on-path attacker could selectively delete frames. After the tamper, every recv
+    AND every send on the victim channel must fail."""
+    monkeypatch.setenv("HIVEMIND_AEAD_THREADS", "4")
+
+    async def scenario():
+        import struct
+
+        client, peer, server = await _connected_pair()
+        await client.send(b"ok-1")
+        assert await peer.recv() == b"ok-1"  # drain first so the raw write below can't race the pipelined writer
+        # an on-path tamper: seal a large frame with the CORRECT next nonce, then
+        # flip one ciphertext byte — framing stays valid, counters stay aligned
+        nonce = struct.pack("<4xQ", client._send_counter)
+        client._send_counter += 1
+        big = bytes(range(256)) * (crypto_channel._OFFLOAD_THRESHOLD // 256 + 1)
+        sealed = bytearray(client._send_aead.encrypt(nonce, big, None))
+        sealed[1000] ^= 0xFF
+        client._writer.write(struct.pack(">I", len(sealed)) + bytes(sealed))
+        await client._writer.drain()
+        await client.send(b"ok-2")  # valid in isolation — must never be delivered
+
+        with pytest.raises(HandshakeError):
+            await peer.recv()
+        # the channel is poisoned: the tampered frame cannot be silently skipped
+        with pytest.raises((HandshakeError, ConnectionError)):
+            await peer.recv()
+        # ... and the victim's send side is failed too
+        with pytest.raises((ConnectionError, HandshakeError)):
+            for _ in range(64):
+                await peer.send(b"x")
+                await asyncio.sleep(0)
+
+        client.close()
+        peer.close()
+        server.close()
+        await server.wait_closed()
+
+    asyncio.run(scenario())
+
+
+def test_two_parked_recv_waiters_both_unblock_on_reader_death(monkeypatch):
+    """One reader-death sentinel must serve EVERY concurrent recv() (round-3
+    advisor, crypto_channel.py:208): the sentinel is re-enqueued before raising, so
+    a second parked waiter raises instead of hanging forever."""
+    monkeypatch.setenv("HIVEMIND_AEAD_THREADS", "0")
+
+    async def scenario():
+        client, peer, server = await _connected_pair()
+        waiters = [asyncio.create_task(peer.recv()) for _ in range(2)]
+        await asyncio.sleep(0.1)  # both park on the empty recv queue
+        client.close()
+        done, pending = await asyncio.wait(waiters, timeout=5)
+        assert not pending, "a parked recv() hung after reader death"
+        for task in done:
+            assert isinstance(
+                task.exception(), (ConnectionError, HandshakeError, asyncio.IncompleteReadError)
+            )
+        peer.close()
+        server.close()
+        await server.wait_closed()
+
+    asyncio.run(scenario())

@@ -406,12 +406,50 @@ async def test_connection_manager_trims_idle_and_redials():
         assert len(live) <= 4, f"{len(live)} live connections past the cap"
 
         # every spoke can still call the hub: trimmed ones re-dial transparently
+        # (echo is read-only, so the ambiguous-loss retry is explicitly allowed)
         for i, spoke in enumerate(spokes):
             response = await spoke.call_protobuf_handler(
-                hub.peer_id, "echo", test_pb2.TestRequest(number=i), test_pb2.TestResponse
+                hub.peer_id, "echo", test_pb2.TestRequest(number=i), test_pb2.TestResponse,
+                idempotent=True,
             )
             assert response.number == i + 1
     finally:
         for spoke in spokes:
             await spoke.shutdown()
         await hub.shutdown()
+
+
+async def test_unary_retry_gated_on_idempotency():
+    """A connection that dies after the request was sent is ambiguous — the handler
+    may already have run. Idempotent calls retry on a fresh connection; calls with
+    side effects fail loudly instead of risking a double-applied optimizer step or
+    a double-advanced decode cache (round-3 advisor, p2p.py:549)."""
+    server = await P2P.create()
+    calls = {"n": 0}
+
+    async def flaky(request: test_pb2.TestRequest, context: P2PContext) -> test_pb2.TestResponse:
+        calls["n"] += 1
+        if calls["n"] == 1:
+            # the handler DID run; the connection dies before the response arrives
+            await server._connections[context.remote_id].close()
+        return test_pb2.TestResponse(number=calls["n"])
+
+    await server.add_protobuf_handler("flaky", flaky, test_pb2.TestRequest)
+    client = await P2P.create()
+    await client.connect(server.get_visible_maddrs()[0])
+    try:
+        response = await client.call_protobuf_handler(
+            server.peer_id, "flaky", test_pb2.TestRequest(number=0), test_pb2.TestResponse,
+            idempotent=True,
+        )
+        assert response.number == 2 and calls["n"] == 2  # retried: attempt 2 answered
+
+        calls["n"] = 0
+        with pytest.raises(P2PHandlerError, match="not marked idempotent"):
+            await client.call_protobuf_handler(
+                server.peer_id, "flaky", test_pb2.TestRequest(number=0), test_pb2.TestResponse
+            )
+        assert calls["n"] == 1  # ran exactly once — no silent second application
+    finally:
+        await client.shutdown()
+        await server.shutdown()
