@@ -76,12 +76,29 @@ def main():
     except ValueError:
         daemon.kill()
         raise RuntimeError(f"unexpected relay daemon output: {first_line!r}") from None
-    # the daemon emits exactly two startup lines in one flush ("relay identity
-    # <hex>" or "relay encryption unavailable"), so a blocking readline cannot
-    # race the stream buffer; anything else is an error — a crypto-capable relay
-    # advertised WITHOUT its identity would silently downgrade every NATed peer
-    # to unpinned registration
-    identity_line = daemon.stdout.readline().strip()
+    # a current daemon emits exactly two startup lines in one flush ("relay
+    # identity <hex>" or "relay encryption unavailable"), so the readline cannot
+    # race the stream buffer; the thread-side timeout only guards a STALE prebuilt
+    # binary from before the two-line protocol (binary-only deployment, no
+    # rebuild) — hanging forever there would be worse than erroring. Anything
+    # unexpected is an error: a crypto-capable relay advertised WITHOUT its
+    # identity would silently downgrade every NATed peer to unpinned registration.
+    import queue as queue_module
+    import threading
+
+    line_queue: "queue_module.Queue[str]" = queue_module.Queue()
+    reader_thread = threading.Thread(
+        target=lambda: line_queue.put(daemon.stdout.readline()), daemon=True
+    )
+    reader_thread.start()
+    try:
+        identity_line = line_queue.get(timeout=10.0).strip()
+    except queue_module.Empty:
+        daemon.kill()
+        raise RuntimeError(
+            "relay daemon did not announce its identity line within 10s — the binary "
+            "predates the two-startup-line protocol; rebuild it (make -C native)"
+        ) from None
     if identity_line.startswith("relay identity "):
         pubkey_hex = identity_line.rsplit(" ", 1)[-1]
         logger.info(f"relay daemon up on port {port} (identity {pubkey_hex[:16]}…)")

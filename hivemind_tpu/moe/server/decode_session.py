@@ -230,7 +230,18 @@ class DecodeSessionManager:
 
     async def _drain(self, uid: str) -> None:
         loop = asyncio.get_running_loop()
-        await asyncio.sleep(self.flush_window)  # let concurrent streams pile up
+        try:
+            await asyncio.sleep(self.flush_window)  # let concurrent streams pile up
+        except asyncio.CancelledError:
+            # cancelled before the entries were even popped (server stop during the
+            # flush window): no pins were taken yet, but the pending futures would
+            # strand forever — cancel them so callers unblock
+            with self._lock:
+                stranded = self._pending.pop(uid, [])
+            for future, _session, _x in stranded:
+                if not future.done():
+                    future.cancel()
+            raise
         with self._lock:
             entries = self._pending.pop(uid, [])
             for _future, session, _x in entries:

@@ -91,11 +91,15 @@ class AutoRelay:
     :param ttl: lifetime of published DHT records; refreshed at half-life
     """
 
-    def __init__(self, p2p, dht, *, max_relays: int = 2, ttl: float = DEFAULT_TTL):
+    def __init__(self, p2p, dht, *, max_relays: int = 2, ttl: float = DEFAULT_TTL,
+                 allow_plaintext: bool = False):
         self.p2p = p2p
         self.dht = dht
         self.max_relays = max_relays
         self.ttl = ttl
+        # opt-OUT of the encrypted-control default: only set True to accept relays
+        # advertised without an identity (legacy no-libcrypto daemons)
+        self.allow_plaintext = allow_plaintext
         self.nat = NATTraversal(p2p)
         self.relay_clients: Dict[Tuple[str, int], RelayClient] = {}
         self._maintenance_task: Optional[asyncio.Task] = None
@@ -114,8 +118,9 @@ class AutoRelay:
         probe_via: Optional[PeerID] = None,
         force_relay: bool = False,
         ttl: float = DEFAULT_TTL,
+        allow_plaintext: bool = False,
     ) -> "AutoRelay":
-        self = cls(p2p, dht, max_relays=max_relays, ttl=ttl)
+        self = cls(p2p, dht, max_relays=max_relays, ttl=ttl, allow_plaintext=allow_plaintext)
         self._probe_via = probe_via
         await self.nat.register_handlers()  # serve nat.check/nat.punch for others
         p2p.set_peer_resolver(self._resolve_and_dial)
@@ -164,9 +169,10 @@ class AutoRelay:
                     host,
                     port,
                     relay_pubkey=pubkey_hex or None,
-                    # an advertised identity means the relay speaks the encrypted
-                    # control protocol: never accept a plaintext downgrade from it
-                    require_encryption=bool(pubkey_hex),
+                    # encrypted by default; a relay advertised WITH an identity can
+                    # never be downgraded (the pin refuses), and one advertised
+                    # without is only accepted under the explicit opt-out
+                    allow_plaintext=self.allow_plaintext and not pubkey_hex,
                 )
                 self.relay_clients[(host, port)] = client
             except Exception as e:
@@ -213,7 +219,7 @@ class AutoRelay:
                 pubkey = circuit.get("pubkey") or None
                 client = RelayClient(
                     self.p2p, host, int(port), relay_pubkey=pubkey,
-                    require_encryption=bool(pubkey),
+                    allow_plaintext=self.allow_plaintext and not pubkey,
                 )
                 await client.dial(peer_id)
                 conn = self.p2p._connections.get(peer_id)

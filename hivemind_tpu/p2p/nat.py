@@ -105,6 +105,9 @@ class NATTraversal:
         peer's dial may land first; either way the connection map is upgraded)."""
         ours = direct_addrs if direct_addrs is not None else self.p2p.get_visible_maddrs()
         request = MSGPackSerializer.dumps([str(m) for m in ours])
-        response = await self.p2p.call_protobuf_handler(peer_id, "nat.punch", request)
+        # punch is effectively idempotent (the handler's dial uses replace_existing),
+        # so the ambiguous-loss retry is safe — and this call races connection churn
+        # by construction
+        response = await self.p2p.call_protobuf_handler(peer_id, "nat.punch", request, idempotent=True)
         their_addrs = [Multiaddr.parse(a) for a in MSGPackSerializer.loads(response)]
         return await self._punch_dial(peer_id, their_addrs)

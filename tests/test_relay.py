@@ -329,3 +329,34 @@ async def test_relay_backpressure_bounds_memory(relay_process):
     assert received == total
     for w in (ws, wd, wa):
         w.close()
+
+
+def test_plaintext_control_refused_by_default():
+    """Encrypted-by-default posture (VERDICT r3 #7): a daemon that does not complete
+    the encrypted handshake is REFUSED unless the caller explicitly opts out with
+    allow_plaintext=True; a pinned identity refuses even under the opt-out."""
+    from hivemind_tpu.p2p.relay import open_relay_channel
+
+    async def scenario():
+        async def legacy_daemon(reader, writer):
+            # a pre-crypto daemon: closes on the unknown handshake frame
+            await reader.read(64)
+            writer.close()
+
+        server = await asyncio.start_server(legacy_daemon, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        with pytest.raises(ConnectionError, match="refused by default"):
+            await open_relay_channel("127.0.0.1", port)
+        # explicit opt-out for a trusted legacy daemon still works...
+        channel = await open_relay_channel("127.0.0.1", port, allow_plaintext=True)
+        assert not channel.encrypted
+        channel.close()
+        # ...but a pinned identity always refuses, opt-out or not
+        with pytest.raises(ConnectionError, match="pinned identity"):
+            await open_relay_channel(
+                "127.0.0.1", port, relay_pubkey=b"\x11" * 32, allow_plaintext=True
+            )
+        server.close()
+        await server.wait_closed()
+
+    asyncio.run(scenario())
