@@ -57,12 +57,15 @@ def main():
         for _ in range(args.num_leaves)
     ]
 
+    # persistent mirrors + streaming per-leaf reduce: steady-state rounds allocate
+    # no whole-tree transients (one reduced leaf in flight; VERDICT r3 #4)
+    mirrors = bridge.allocate_reduced_mirrors(stacked, reduce_axis="dp")
+
     def one_round():
-        reduced = bridge.mesh_mean(stacked, axis="dp")
-        host = bridge.gather_to_host(reduced)
-        back = bridge.broadcast_scatter_from_host(stacked, host, axis="dp")
+        bridge.stage_reduced_into_mirrors(stacked, mirrors, reduce_axis="dp")
+        back = bridge.broadcast_scatter_from_host(stacked, mirrors, axis="dp")
         jax.block_until_ready(back)
-        return host
+        return mirrors
 
     import resource
 

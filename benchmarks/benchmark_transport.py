@@ -13,6 +13,16 @@ Modes:
                      m-core host the aggregate scales with min(k, m) until the event
                      loop (framing + protobuf) saturates one core.
   --relay            route the stream through the native C++ relay daemon's splice
+  --via-daemon       the CLIENT dials through the native daemon's local DATA-PLANE
+                     PROXY ('X' mode): Python ships plaintext frames over loopback
+                     and the daemon does the ChaCha20-Poly1305 seal + wire IO in
+                     C++ (reference architecture: the whole transport lives in the
+                     Go daemon, p2p_daemon.py:84-147). On a one-core host the
+                     total cipher work is unchanged (daemon seal + python open
+                     share the core), so expect a flat-to-modest delta HERE; the
+                     point is the native path exists, is correct, and moves the
+                     sender's AEAD out of the Python event loop for multi-core
+                     hosts.
 """
 
 import os
@@ -74,8 +84,8 @@ async def run_pair(args):
     from hivemind_tpu.compression import serialize_tensor
 
     relay_proc = None
-    if args.relay:
-        # route the stream through the native relay daemon (splice data path)
+    if args.relay or args.via_daemon:
+        # spawn the native daemon (relay splice and/or data-plane proxy)
         native = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                               "hivemind_tpu", "native")
         subprocess.run(["make"], cwd=native, check=True, capture_output=True)
@@ -83,9 +93,10 @@ async def run_pair(args):
             [os.path.join(native, "relay_daemon"), "0"], stdout=subprocess.PIPE, text=True
         )
         relay_port = int(relay_proc.stdout.readline().strip().rsplit(" ", 1)[-1])
+        relay_proc.stdout.readline()  # identity / encryption-unavailable line
 
     server = await P2P.create()
-    client = await P2P.create()
+    client = await P2P.create(data_proxy_port=relay_port if args.via_daemon else None)
     received = await _add_sink(server)
 
     if args.relay:
@@ -114,6 +125,8 @@ async def run_pair(args):
             "streams": args.streams,
             "aead_threads": os.environ.get("HIVEMIND_AEAD_THREADS", "auto"),
             "path": ("relay splice + noise AEAD + mux, localhost" if args.relay
+                     else "native daemon data-plane proxy (C++ AEAD) + mux, localhost"
+                     if args.via_daemon
                      else "tcp + noise AEAD + mux, localhost"),
         },
     }))
@@ -210,6 +223,8 @@ def main():
                         help="concurrent streams over one connection (in-process mode)")
     parser.add_argument("--procs", type=int, default=0,
                         help="client processes against one server process (aggregate mode)")
+    parser.add_argument("--via-daemon", action="store_true", dest="via_daemon",
+                        help="client dials through the native data-plane proxy")
     parser.add_argument("--relay", action="store_true",
                         help="route through the native relay daemon (circuit splice)")
     parser.add_argument("--role", choices=["server", "client"], help=argparse.SUPPRESS)

@@ -233,6 +233,14 @@ class DecentralizedAverager(ServicerBase):
         payload = MSGPackSerializer.dumps([schema, type(self.compression).__name__, "v1"])
         return hashlib.sha256(payload).hexdigest()[:32]
 
+    def _suggested_lead(self) -> float:
+        """Adaptive matchmaking lead time (VERDICT r3 #5): when the caller does not
+        pin a scheduled_time, use the matchmaking layer's observed declare→fill
+        latency + failure backoff instead of the raw ``min_matchmaking_time``."""
+        if self.matchmaking is not None:
+            return self.matchmaking.suggested_lead_time()
+        return self.min_matchmaking_time
+
     def _get_peer_stub(self, peer_id: PeerID):
         return type(self).get_stub(self.p2p, peer_id, namespace=self.prefix)
 
@@ -262,7 +270,7 @@ class DecentralizedAverager(ServicerBase):
         weight = weight if weight is not None else float(self.mode != AveragingMode.AUX)
         now = get_dht_time()
         control = StepControl(
-            scheduled_time=scheduled_time if scheduled_time is not None else now + self.min_matchmaking_time,
+            scheduled_time=scheduled_time if scheduled_time is not None else now + self._suggested_lead(),
             deadline=now + timeout if timeout is not None else None,
             allow_retries=allow_retries,
             weight=weight,
@@ -314,7 +322,7 @@ class DecentralizedAverager(ServicerBase):
                     # otherwise re-synchronize and livelock (everyone re-declares the
                     # same deadline and nobody becomes anyone's leader)
                     jitter = random.uniform(0.8, 1.6)
-                    control.reset_for_retry(get_dht_time() + self.min_matchmaking_time * jitter)
+                    control.reset_for_retry(get_dht_time() + self._suggested_lead() * jitter)
         except asyncio.CancelledError:
             control.cancel()
             raise

@@ -107,8 +107,8 @@ class SliceAverager:
             # mesh is collective): an allocate-only follower would leave process 0
             # blocked in the init collective while the follower races ahead to
             # phase 1, pairing mismatched programs — a permanent deadlock
-            self._follower_mirrors = self.bridge.gather_to_host(
-                self._reduced_like(device_tree)
+            self._follower_mirrors = self.bridge.gather_reduced_to_host(
+                device_tree, reduce_axis=local_reduce_axis
             )
 
     # ------------------------------------------------------------------ helpers
@@ -135,14 +135,19 @@ class SliceAverager:
         """One collective swarm round. Every process of the slice must call this;
         returns True when the swarm round succeeded and the averaged values were
         adopted, False when the round failed (device state is left unchanged)."""
-        # -------- phase 1: stage (collective) --------
-        reduced = self._reduced_like(self._device_tree)
+        # -------- phase 1: stage (collective; per-leaf streaming reduce) --------
         if self.is_network_process:
             assert self.averager is not None
             with self.averager.lock_averaged_tensors:
-                self.bridge.stage_into_mirrors(reduced, self.averager._averaged_tensors)
+                self.bridge.stage_reduced_into_mirrors(
+                    self._device_tree, self.averager._averaged_tensors,
+                    reduce_axis=self.local_reduce_axis,
+                )
         else:
-            self.bridge.stage_into_mirrors(reduced, self._follower_mirrors)
+            self.bridge.stage_reduced_into_mirrors(
+                self._device_tree, self._follower_mirrors,
+                reduce_axis=self.local_reduce_axis,
+            )
 
         # -------- phase 2: swarm round (network process only) --------
         ok = False

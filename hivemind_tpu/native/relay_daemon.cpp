@@ -33,6 +33,16 @@
 //   WHOAMI    'W'                        -> 'O' <ip:port>  (the conn's observed
 //             public endpoint — the STUN-style observation NATed peers need for
 //             hole punching; role parity with libp2p identify/observed-addr)
+//   PROXY     'X' <u16 BE port> <ip>     -> 'O' once the outbound connect lands.
+//             Local DATA-PLANE proxy: the daemon terminates the peer's channel
+//             AEAD so Python ships plaintext frames over loopback and the native
+//             side does ChaCha20-Poly1305 + wire IO (the reference keeps its whole
+//             transport in the Go daemon the same way, p2p_daemon.py:84-147).
+//             After 'O': local frame #1 (hello) crosses raw, frame #2 must be
+//             'K' <send_key 32><recv_key 32><LE64 send_ctr><LE64 recv_ctr>
+//             (consumed), frames #3+ are sealed toward the wire; wire frame #1
+//             (peer hello) crosses raw, #2+ are opened with recv_key. Ciphertext
+//             arriving before 'K' is held, so the upgrade cannot race.
 // After 'O' on a DIAL/ACCEPT pair the two sockets are spliced byte-for-byte.
 //
 // Usage: relay_daemon [port] [identity_file]
@@ -331,7 +341,19 @@ static bool fill_random(unsigned char* buf, size_t len) {
   return true;
 }
 
-enum class ConnState { ReadingFrame, Control, SplicedWaiting, Spliced, Closed };
+enum class ConnState {
+  ReadingFrame, Control, SplicedWaiting, Spliced, Closed,
+  // local data-plane proxy ('X'): the daemon terminates the peer's AEAD, so the
+  // Python event loop ships PLAINTEXT frames over loopback and the native side
+  // does the ChaCha20-Poly1305 seal/open + wire IO (reference role parity: the
+  // entire transport lives in the Go daemon, hivemind/p2p/p2p_daemon.py:84-147)
+  ProxyLocalWait,   // local conn: 'X' accepted, outbound connect in flight
+  ProxyConnecting,  // outbound conn: awaiting connect() completion
+  ProxyLocal,       // local side of an established proxy pair (plaintext frames)
+  ProxyRemote,      // remote side (wire AEAD frames; holds the pair's keys)
+};
+
+static constexpr size_t MAX_PROXY_FRAME = (16u << 20) + 16;  // crypto_channel MAX_FRAME_SIZE + tag
 
 struct Conn {
   int fd = -1;
@@ -346,6 +368,11 @@ struct Conn {
   bool enc = false;
   unsigned char send_key[32] = {0}, recv_key[32] = {0};
   uint64_t send_ctr = 0, recv_ctr = 0;
+  // proxy pair ('X'): key material lives on the ProxyRemote conn (send = seal
+  // local->wire, recv = open wire->local); distinct from `enc` so queue_frame's
+  // control sealing can never alias the data-plane keys
+  uint64_t proxy_frames = 0;  // parsed frames in this direction (1 = raw hello)
+  bool proxy_keys = false;
   int peer_fd = -1;         // spliced counterpart
   double created_ms = 0;
   bool want_write = false;
@@ -474,6 +501,79 @@ static void refuse_and_close(Conn* c) {
   update_events(c);
 }
 
+static void forward_frame(Conn* partner, const std::string& payload) {
+  uint32_t be = htonl((uint32_t)payload.size());
+  std::string frame((char*)&be, 4);
+  frame += payload;
+  queue_write(partner, frame.data(), frame.size());
+}
+
+static bool proxy_process(Conn* c) {
+  // Parse frames buffered on one side of a proxy pair; returns false when `c`
+  // was closed. Frame protocol per direction (fixed by the Python handshake):
+  //   local  #1 = plaintext hello (forward raw)   #2 = 'K' key install (consume)
+  //          #3+ = plaintext payloads (seal toward the wire)
+  //   remote #1 = plaintext hello (forward raw)   #2+ = AEAD ciphertext (open);
+  //          held unparsed until the keys arrive — race-free by construction
+  auto pit = g_conns.find(c->peer_fd);
+  if (pit == g_conns.end()) { close_conn(c->fd); return false; }
+  Conn* partner = pit->second;
+  Conn* remote = (c->state == ConnState::ProxyRemote) ? c : partner;
+  while (c->inbuf.size() >= 4) {
+    uint32_t len = ntohl(*(uint32_t*)c->inbuf.data());
+    if (len > MAX_PROXY_FRAME) { close_conn(c->fd); return false; }
+    if (c->inbuf.size() < 4 + (size_t)len) break;
+    if (c->state == ConnState::ProxyRemote && c->proxy_frames >= 1 && !c->proxy_keys)
+      break;  // ciphertext before the local 'K': hold (bounded by the flood cap)
+    std::string payload = c->inbuf.substr(4, len);
+    c->inbuf.erase(0, 4 + len);
+    c->proxy_frames++;
+    if (c->proxy_frames == 1) {  // the handshake hello crosses unmodified
+      forward_frame(partner, payload);
+      continue;
+    }
+    if (c->state == ConnState::ProxyLocal) {
+      if (c->proxy_frames == 2) {  // 'K' + send_key + recv_key + LE64 ctr x2
+        if (payload.size() != 1 + 32 + 32 + 8 + 8 || payload[0] != 'K' ||
+            !relay_crypto::channel_available) {
+          close_conn(c->fd);
+          return false;
+        }
+        memcpy(remote->send_key, payload.data() + 1, 32);
+        memcpy(remote->recv_key, payload.data() + 33, 32);
+        memcpy(&remote->send_ctr, payload.data() + 65, 8);
+        memcpy(&remote->recv_ctr, payload.data() + 73, 8);
+        remote->proxy_keys = true;
+        // wire frames that arrived before the keys can drain now; a dead remote
+        // makes the pair useless, so tear down both sides
+        if (remote != c && !remote->inbuf.empty()) {
+          int self_fd = c->fd;
+          if (!proxy_process(remote)) {
+            if (g_conns.find(self_fd) != g_conns.end()) close_conn(self_fd);
+            return false;
+          }
+        }
+        continue;
+      }
+      std::string sealed;
+      if (!remote->proxy_keys ||
+          !relay_crypto::aead_seal(remote->send_key, remote->send_ctr++, payload, sealed)) {
+        close_conn(c->fd);
+        return false;
+      }
+      forward_frame(partner, sealed);
+    } else {  // ProxyRemote: open wire ciphertext, forward plaintext to local
+      std::string opened;
+      if (!relay_crypto::aead_open(c->recv_key, c->recv_ctr++, payload, opened)) {
+        close_conn(c->fd);  // tampered/desynced frame is fatal to the pair
+        return false;
+      }
+      forward_frame(partner, opened);
+    }
+  }
+  return true;
+}
+
 static void handle_control_frame(Conn* c, const std::string& payload) {
   if (payload.empty()) { close_conn(c->fd); return; }
   char kind = payload[0];
@@ -582,6 +682,46 @@ static void handle_control_frame(Conn* c, const std::string& payload) {
     g_pending_dials[token] = c->fd;
     c->created_ms = now_ms();
     queue_frame(target_conn->second, std::string("I") + token);
+  } else if (kind == 'X' && payload.size() >= 4) {
+    // PROXY-CONNECT: 'X' + u16 BE port + ip — open an outbound data-plane
+    // connection; reply 'O' once connected, then frame-forward with AEAD
+    // termination (see proxy_process). Requires libcrypto (the whole point is
+    // native seal/open). STRICTLY LOOPBACK-ONLY: this is a local data-plane
+    // offload for co-resident peers — honoring it from a remote client would
+    // turn every public relay into an open TCP proxy / SSRF vector.
+    sockaddr_in src{};
+    socklen_t slen = sizeof(src);
+    bool local_client = getpeername(c->fd, (sockaddr*)&src, &slen) == 0 &&
+                        (ntohl(src.sin_addr.s_addr) >> 24) == 127;
+    if (!local_client || c->peer_fd >= 0 || c->enc || !relay_crypto::channel_available) {
+      refuse_and_close(c);
+      return;
+    }
+    uint16_t port = ((uint8_t)payload[1] << 8) | (uint8_t)payload[2];
+    std::string host = payload.substr(3);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port);
+    if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) { refuse_and_close(c); return; }
+    int rfd = socket(AF_INET, SOCK_STREAM, 0);
+    if (rfd < 0) { refuse_and_close(c); return; }
+    set_nonblock(rfd);
+    int rc = connect(rfd, (sockaddr*)&addr, sizeof(addr));
+    if (rc < 0 && errno != EINPROGRESS) { close(rfd); refuse_and_close(c); return; }
+    Conn* r = new Conn();
+    r->fd = rfd;
+    r->state = ConnState::ProxyConnecting;
+    r->created_ms = now_ms();
+    r->peer_fd = c->fd;
+    r->want_write = true;
+    g_conns[rfd] = r;
+    epoll_event rev{};
+    rev.events = EPOLLOUT;
+    rev.data.fd = rfd;
+    epoll_ctl(g_epoll, EPOLL_CTL_ADD, rfd, &rev);
+    c->peer_fd = rfd;
+    c->state = ConnState::ProxyLocalWait;
+    c->created_ms = now_ms();
   } else if (kind == 'W') {
     sockaddr_in observed{};
     socklen_t olen = sizeof(observed);
@@ -627,6 +767,23 @@ static void on_readable(Conn* c) {
         update_events(c);
         break;
       }
+    } else if (c->state == ConnState::ProxyLocal || c->state == ConnState::ProxyRemote) {
+      c->inbuf.append(buf, n);
+      // pre-key flood bound: a remote shipping ciphertext before the local 'K'
+      // may buffer at most one max frame + slack
+      if (c->inbuf.size() > MAX_PROXY_FRAME + (1u << 20)) { close_conn(c->fd); return; }
+      if (!proxy_process(c)) return;
+      auto pit = g_conns.find(c->peer_fd);
+      if (pit != g_conns.end() && pit->second->outbuf.size() > HIGH_WATER) {
+        c->read_paused = true;
+        update_events(c);
+        break;
+      }
+    } else if (c->state == ConnState::ProxyLocalWait) {
+      // outbound connect still in flight: buffer (the peer should be awaiting
+      // our 'O', so this is at most an eager hello)
+      c->inbuf.append(buf, n);
+      if (c->inbuf.size() > MAX_FRAME) { close_conn(c->fd); return; }
     } else {
       c->inbuf.append(buf, n);
       while (c->state != ConnState::Spliced && c->inbuf.size() >= 4) {
@@ -662,6 +819,25 @@ static void maybe_resume_partner(Conn* c) {
 }
 
 static void on_writable(Conn* c) {
+  if (c->state == ConnState::ProxyConnecting) {
+    int err = 0;
+    socklen_t elen = sizeof(err);
+    getsockopt(c->fd, SOL_SOCKET, SO_ERROR, &err, &elen);
+    auto pit = g_conns.find(c->peer_fd);
+    if (err != 0 || pit == g_conns.end()) { close_conn(c->fd); return; }
+    c->state = ConnState::ProxyRemote;
+    c->want_write = false;
+    int one = 1;
+    setsockopt(c->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    enable_keepalive(c->fd);
+    update_events(c);
+    Conn* local = pit->second;
+    local->state = ConnState::ProxyLocal;
+    enable_keepalive(local->fd);
+    queue_frame(local, "O");
+    if (!local->inbuf.empty()) proxy_process(local);  // an eager hello was buffered
+    return;
+  }
   while (!c->outbuf.empty()) {
     ssize_t n = write(c->fd, c->outbuf.data(), c->outbuf.size());
     if (n < 0) {
@@ -787,6 +963,9 @@ int main(int argc, char** argv) {
       }
       for (auto& [fd, conn] : g_conns) {
         if (conn->closing_after_flush && now_ms() - conn->created_ms > FLUSH_TTL_MS)
+          expired.push_back(fd);
+        if ((conn->state == ConnState::ProxyConnecting || conn->state == ConnState::ProxyLocalWait)
+            && now_ms() - conn->created_ms > PENDING_DIAL_TTL_MS)
           expired.push_back(fd);
       }
       for (int fd : expired) close_conn(fd);

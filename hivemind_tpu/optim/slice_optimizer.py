@@ -444,14 +444,18 @@ class SliceOptimizer:
         whole slice adopts it collectively (broadcast + shard upload) — the
         reference load_state_from_peers path (optimizer.py:655-717), landing on
         every process's shards. Returns True when a donor's state was adopted."""
-        header = np.zeros(2, np.float32)  # [ok, epoch]
+        # header = [ok, epoch]: the epoch is broadcast on BOTH outcomes — on the
+        # failure path every process must adopt the SAME epoch (process 0's view
+        # can differ from a follower's argument, and divergent epochs desync the
+        # collective schedule of later phases)
+        header = np.asarray([0.0, float(global_epoch)], np.float32)
         tensors: Optional[List[np.ndarray]] = None
         if self.is_network_process:
             assert self.state_averager is not None
             logger.info(
                 f"slice epoch {self.local_epoch} is behind the swarm ({global_epoch}); downloading state"
             )
-            expected = len(self._params_leaves) + len(self._averaged_opt_indices)
+            state_leaves = self._state_leaves()
             try:
                 result = self.state_averager.load_state_from_peers(timeout=self.load_state_timeout)
             except Exception as e:
@@ -459,7 +463,14 @@ class SliceOptimizer:
                 result = None
             if result is not None:
                 metadata, downloaded = result
-                if len(downloaded) == expected:
+                # count AND per-leaf sizes must match BEFORE broadcasting ok=1: a
+                # shape-mismatched donor failing mid-adoption would leave the
+                # followers parked in a leaf broadcast forever
+                shapes_ok = len(downloaded) == len(state_leaves) and all(
+                    np.asarray(t).size == int(np.prod(leaf.shape))
+                    for t, leaf in zip(downloaded, state_leaves)
+                )
+                if shapes_ok:
                     tensors = [np.asarray(t, np.float32) for t in downloaded]
                     epoch = (
                         int(metadata["epoch"])
@@ -469,14 +480,16 @@ class SliceOptimizer:
                     header = np.asarray([1.0, float(max(epoch, global_epoch))], np.float32)
                 else:
                     logger.warning(
-                        f"donor sent {len(downloaded)} tensors, expected {expected}; ignoring"
+                        f"donor state does not match our schema "
+                        f"({len(downloaded)} tensors vs {len(state_leaves)} expected); ignoring"
                     )
         header = _broadcast(header)
         ok, adopted_epoch = bool(header[0] >= 0.5), int(header[1])
         if not ok:
-            # could not download: adopt the epoch number so we stop re-triggering
+            # could not download: every process adopts the BROADCAST epoch so we
+            # stop re-triggering and stay in collective lockstep
             # (reference optimizer.py:481-482 fallback)
-            self.local_epoch = max(self.local_epoch, global_epoch)
+            self.local_epoch = max(self.local_epoch, adopted_epoch)
             return False
 
         # collective adoption: per-leaf broadcast from process 0, then every

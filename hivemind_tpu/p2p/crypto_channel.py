@@ -318,6 +318,23 @@ async def _recv_plain(reader: asyncio.StreamReader, max_size: int = 4096) -> byt
     return await reader.readexactly(length)
 
 
+class _NullAEAD:
+    """Cipher stand-in for daemon-proxied channels: the LOCAL hop to the native
+    data-plane proxy carries plaintext frames (loopback trust boundary — exactly
+    the reference's unix-socket hop to its Go daemon, p2p_daemon.py:84-147); the
+    daemon performs the real ChaCha20-Poly1305 with the keys handed over in the
+    'K' upgrade frame. Wire format and security toward the REMOTE peer are
+    unchanged."""
+
+    @staticmethod
+    def encrypt(nonce: bytes, data: bytes, aad) -> bytes:
+        return data
+
+    @staticmethod
+    def decrypt(nonce: bytes, data: bytes, aad) -> bytes:
+        return data
+
+
 async def handshake(
     reader: asyncio.StreamReader,
     writer: asyncio.StreamWriter,
@@ -325,9 +342,15 @@ async def handshake(
     is_initiator: bool,
     announced_addrs: Optional[list] = None,
     timeout: float = 15.0,
+    proxy_upgrade: bool = False,
 ) -> Tuple[SecureChannel, dict]:
     """Perform the mutual-authentication handshake. Returns (channel, peer_hello_extras)
-    where extras carries the peer's announced listen addresses."""
+    where extras carries the peer's announced listen addresses.
+
+    ``proxy_upgrade``: the stream runs through the native daemon's data-plane
+    proxy ('X' mode): after deriving keys, hand them to the daemon in a 'K' frame
+    and switch this end to plaintext framing — the daemon seals/opens every
+    subsequent frame (including the key-confirmation exchange) in C++."""
 
     async def _run() -> Tuple[SecureChannel, dict]:
         ephemeral = X25519PrivateKey.generate()
@@ -363,6 +386,18 @@ async def handshake(
             (initiator_key, responder_key) if is_initiator else (responder_key, initiator_key)
         )
         channel = SecureChannel(reader, writer, send_key, recv_key, peer_static)
+        if proxy_upgrade:
+            # hand the channel keys (and current counters — the confirm below is
+            # the first sealed frame each way) to the local daemon, then go
+            # plaintext on this hop: the daemon does the AEAD from here on
+            upgrade = (
+                b"K" + send_key + recv_key
+                + struct.pack("<Q", channel._send_counter)
+                + struct.pack("<Q", channel._recv_counter)
+            )
+            await _send_plain(writer, upgrade)
+            channel._send_aead = _NullAEAD()  # type: ignore[assignment]
+            channel._recv_aead = _NullAEAD()  # type: ignore[assignment]
         # key confirmation: proves the peer holds the ephemeral private key, which a
         # replayed hello cannot (helloes alone are replayable — sig covers only the
         # static prefix + own ephemeral). Both sides send first, then verify.

