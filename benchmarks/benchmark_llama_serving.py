@@ -89,6 +89,20 @@ def main():
         )
         load_seconds = time.perf_counter() - load_start
         resident_mb = sum(b.param_bytes() for b in backends.values()) / 1e6
+        # planning accuracy (VERDICT r3 #8): the capacity planner's input vs reality
+        from hivemind_tpu.moe.server.llama_loader import (
+            decode_cache_bytes, plan_block_capacity, predict_block_param_bytes,
+        )
+
+        predicted_block = predict_block_param_bytes(
+            config, "int8" if args.int8 else None
+        )
+        measured_block = next(iter(backends.values())).param_bytes()
+        cache_bytes = decode_cache_bytes(config, batch=1, max_len=args.decode_max_len)
+        plan_16gb = plan_block_capacity(
+            predicted_block, hbm_bytes=16 * 1024**3,
+            decode_sessions=8, cache_bytes_per_session_block=cache_bytes,
+        )
 
         dht = DHT(start=True)
         server = Server(dht, backends, decode_max_len=args.decode_max_len)
@@ -119,6 +133,13 @@ def main():
                     "inner": config.intermediate_size,
                     "int8": args.int8, "resident_mb": round(resident_mb, 1),
                     "load_seconds": round(load_seconds, 2),
+                    "per_block_load_seconds": round(load_seconds / max(len(backends), 1), 2),
+                    "predicted_block_mb": round(predicted_block / 1e6, 1),
+                    "measured_block_mb": round(measured_block / 1e6, 1),
+                    "prediction_error_pct": round(
+                        100.0 * abs(predicted_block - measured_block) / max(measured_block, 1), 2
+                    ),
+                    "planned_blocks_16gb_8sessions": plan_16gb,
                     "prompt": args.prompt, "generated": args.generate,
                     "prefill_included_tok_s": round((args.prompt + args.generate) / elapsed, 1),
                 },

@@ -316,7 +316,10 @@ class RemoteSequential:
         # max_failover_history — past the cap, retention stops and a dead peer is
         # a hard error again (restart with reset=True), bounding client memory
         if reset:
-            state["chunks"], state["positions"] = [x], x.shape[1]
+            if self.max_failover_history and x.shape[1] <= self.max_failover_history:
+                state["chunks"], state["positions"] = [x], x.shape[1]
+            else:  # retention disabled (cap 0) or the prompt alone exceeds the cap
+                state["chunks"], state["positions"] = None, 0
         elif state["chunks"] is not None:
             if state["positions"] + x.shape[1] <= self.max_failover_history:
                 state["chunks"].append(x)
@@ -336,7 +339,17 @@ class RemoteSequential:
                 f"failing over: re-resolving the route and re-prefilling from "
                 f"{history.shape[1]} retained positions"
             )
-            out = self._decode_failover(session_id, state, history)
+            try:
+                out = self._decode_failover(session_id, state, history)
+            except Exception:
+                # a FAILED failover leaves surviving servers' caches re-prefilled to
+                # an unknown point and this chunk already in the history: the
+                # session is unusable — forget it so a caller retry gets the
+                # explicit "start with reset=True" error instead of silent
+                # divergence
+                with self._lock:
+                    self._decode_routes.pop(session_id, None)
+                raise
             if not reset:
                 out = out[:, -x.shape[1]:]  # the caller expects this step's positions only
         return out

@@ -22,9 +22,10 @@ when many clients decode one token at a time. Disable with
 
 No reference equivalent (the reference serves stateless experts; Petals is its
 downstream project — README.md:35-40). Fault note: decode sessions are sticky to
-the serving peer — if it dies, the client must re-prefill on a replacement
-(`RemoteSequential.decode_step` raises rather than silently resuming with an empty
-cache)."""
+the serving peer, and since r4 a dead peer fails over TRANSPARENTLY — the client
+retains the session's input history and re-prefills a replacement
+(`RemoteSequential.decode_step`; past the retention cap it degrades to raising,
+and the caller restarts with ``reset=True``)."""
 
 from __future__ import annotations
 

@@ -371,11 +371,21 @@ class P2P:
         replaces the relayed one; in-flight streams finish on the old connection)."""
         via_proxy = self._data_proxy_port is not None
         if via_proxy:
-            reader, writer = await asyncio.wait_for(
-                self._open_proxied_connection(maddr.host, maddr.port),
-                timeout=self._dial_timeout,
-            )
-        else:
+            try:
+                reader, writer = await asyncio.wait_for(
+                    self._open_proxied_connection(maddr.host, maddr.port),
+                    timeout=self._dial_timeout,
+                )
+            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                # the proxy is an optimization, not a reachability requirement:
+                # degrade to a direct dial rather than failing an address a plain
+                # socket could reach
+                logger.debug(
+                    f"data-plane proxy dial to {maddr.host}:{maddr.port} failed "
+                    f"({e!r}); falling back to a direct dial"
+                )
+                via_proxy = False
+        if not via_proxy:
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(maddr.host, maddr.port), timeout=self._dial_timeout
             )
@@ -417,8 +427,20 @@ class P2P:
         'X' <port><host> to the daemon, wait for 'O', then the stream behaves like
         a direct socket (the daemon forwards; the AEAD moves into it after the
         handshake's 'K' upgrade — see crypto_channel.handshake proxy_upgrade)."""
+        import socket as socket_module
         import struct
 
+        try:
+            socket_module.inet_aton(host)
+        except OSError:
+            # the daemon's 'X' handler takes IPv4 literals only: resolve dns/ip6
+            # hosts here (first IPv4 answer) before shipping the target
+            infos = await asyncio.get_running_loop().getaddrinfo(
+                host, port, family=socket_module.AF_INET, type=socket_module.SOCK_STREAM
+            )
+            if not infos:
+                raise ConnectionError(f"no IPv4 address for {host!r} (data-plane proxy is IPv4-only)")
+            host = infos[0][4][0]
         reader, writer = await asyncio.open_connection("127.0.0.1", self._data_proxy_port)
         request = b"X" + struct.pack(">H", port) + host.encode()
         writer.write(struct.pack(">I", len(request)) + request)

@@ -356,3 +356,37 @@ def test_generation_across_two_servers(tmp_path):
         server_a.shutdown()
         dht_b.shutdown()
         dht_a.shutdown()
+
+
+def test_predicted_block_bytes_match_measured_gqa(tmp_path):
+    """plan_block_capacity's planning input must be trustworthy BEFORE weights
+    load (VERDICT r3 #8): predict_block_param_bytes from config arithmetic alone
+    must match the measured resident bytes of a loaded block within 10%, for both
+    fp32 and int8, at a GQA shape (hidden 1024, 4 layers, kv_heads < heads,
+    sharded index)."""
+    import json as json_module
+
+    from benchmarks.benchmark_llama_serving import synthesize_checkpoint
+    from hivemind_tpu.moe.server.llama_loader import (
+        LlamaCheckpointConfig,
+        load_llama_blocks,
+        predict_block_param_bytes,
+    )
+
+    synthesize_checkpoint(tmp_path, hidden=1024, heads=8, kv_heads=2, inner=2816, layers=4)
+    index = json_module.loads((tmp_path / "model.safetensors.index.json").read_text())
+    assert len(set(index["weight_map"].values())) == 4  # genuinely sharded
+    config = LlamaCheckpointConfig.load(tmp_path)
+    assert config.num_key_value_heads < config.num_attention_heads  # GQA
+
+    for quantization in (None, "int8"):
+        predicted = predict_block_param_bytes(config, quantization)
+        backends, _ = load_llama_blocks(
+            tmp_path, uid_prefix="pb.", weight_quantization=quantization, layers=[0]
+        )
+        measured = backends["pb.0"].param_bytes()
+        assert abs(predicted - measured) <= 0.10 * measured, (
+            f"{quantization}: predicted {predicted} vs measured {measured}"
+        )
+    # int8 must actually shrink the block ~4x
+    assert predict_block_param_bytes(config, "int8") < 0.3 * predict_block_param_bytes(config)

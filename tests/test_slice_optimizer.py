@@ -189,3 +189,23 @@ def test_full_optimizer_on_two_process_slice(tmp_path):
         for worker in workers:
             if worker.poll() is None:
                 worker.kill()
+
+
+def test_slice_collaborative_example_single_process():
+    """The recipe in examples/slice_collaborative_training.py runs end to end on a
+    single-process virtual mesh: a solo swarm still advances epochs (no round is
+    attempted below 2 peers; local gradients apply) and the script exits cleanly."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # the example sets its own device-count flag
+    result = subprocess.run(
+        [sys.executable, os.path.join(repo, "examples", "slice_collaborative_training.py"),
+         "--platform", "cpu", "--devices_per_proc", "4", "--steps", "24",
+         "--target_batch_size", "64", "--batch_size", "32", "--dim", "16"],
+        capture_output=True, text=True, timeout=420, env=env,
+    )
+    assert result.returncode == 0, (result.stdout + result.stderr)[-3000:]
+    combined = result.stdout + result.stderr
+    assert "done: epoch" in combined, combined[-2000:]
+    final_epoch = int(combined.rsplit("done: epoch", 1)[1].strip().split()[0])
+    assert final_epoch >= 5, combined[-2000:]
