@@ -399,15 +399,22 @@ class SliceOptimizer:
                 f"(averaged={averaged_ok}, peers={num_peers})"
             )
 
-    def _collective_state_phase(self, next_epoch: int, num_peers: int) -> None:
-        """Stage params+opt-stats to the state mirrors; every ``average_state_every``
-        epochs additionally average them with the swarm and adopt the result."""
+    def _refresh_state_mirrors(self) -> List[np.ndarray]:
+        """COLLECTIVE: stage current params+opt-stats to every process's host
+        copies and (network process) into the state averager's mirrors, so state
+        downloads serve fresh tensors. Returns the per-process host copies."""
         state_scratch = self.bridge.gather_to_host(self._state_leaves())
         if self.is_network_process:
             assert self.state_averager is not None
             with self.state_averager.get_tensors() as tensors:
                 for tensor, fresh in zip(tensors, state_scratch):
                     np.copyto(tensor, fresh)
+        return state_scratch
+
+    def _collective_state_phase(self, next_epoch: int, num_peers: int) -> None:
+        """Stage params+opt-stats to the state mirrors; every ``average_state_every``
+        epochs additionally average them with the swarm and adopt the result."""
+        state_scratch = self._refresh_state_mirrors()
 
         run_round = num_peers > 1 and next_epoch % self.average_state_every == 0
         if not run_round:
@@ -499,16 +506,36 @@ class SliceOptimizer:
         for i, leaf in enumerate(state_leaves):
             value = tensors[i] if tensors is not None else np.zeros(leaf.shape, np.float32)
             adopted.append(_broadcast(np.ascontiguousarray(value.reshape(leaf.shape))))
-        self._adopt_state_tensors(adopted)
-        self._set_opt_counts(adopted_epoch)
-        self.local_epoch = adopted_epoch
-        self._accum = self._jit_zeros_like()(self.params)
-        self._samples = 0
-        if self.is_network_process:
-            assert self.tracker is not None
-            self.tracker.report_local_progress(self.local_epoch, 0)
+        self._adopt_checkpoint(adopted, adopted_epoch)
         logger.info(f"[proc {self.process_index}] slice adopted swarm state at epoch {adopted_epoch}")
         return True
+
+    def _adopt_checkpoint(self, tensors: List[np.ndarray], epoch: int) -> None:
+        """Shared adoption tail for catch-up and checkpoint restore (COLLECTIVE):
+        validate against the state schema BEFORE touching anything (a half-restored
+        optimizer — new params, stale Adam moments — is worse than an error), write
+        the sharded device state, fast-forward counters/epoch, reset accumulation,
+        and restage the state mirrors so downloads immediately serve the adopted
+        state at its true epoch rather than init-time zeros."""
+        state_leaves = self._state_leaves()
+        if len(tensors) != len(state_leaves) or any(
+            int(np.asarray(t).size) != int(np.prod(leaf.shape))
+            for t, leaf in zip(tensors, state_leaves)
+        ):
+            raise ValueError(
+                f"checkpoint tensors do not match the state schema "
+                f"({len(tensors)} tensors vs {len(state_leaves)} leaves)"
+            )
+        self._adopt_state_tensors(tensors)
+        self._set_opt_counts(epoch)
+        self.local_epoch = epoch
+        self._accum = self._jit_zeros_like()(self.params)
+        self._samples = 0
+        self._refresh_state_mirrors()
+        if self.is_network_process:
+            assert self.state_averager is not None and self.tracker is not None
+            self.state_averager.state_sharing_priority = epoch
+            self.tracker.report_local_progress(epoch, 0)
 
     def _adopt_state_tensors(self, host_tensors: List[np.ndarray]) -> None:
         """Write host values (identical on every process) into the sharded device
@@ -548,6 +575,29 @@ class SliceOptimizer:
         self.opt_state = jax.tree_util.tree_unflatten(self._opt_treedef, new_leaves)
 
     # ------------------------------------------------------------------ lifecycle
+
+    def state_dict(self) -> dict:
+        """User-level checkpoint with the epoch embedded (API parity with
+        ``Optimizer.state_dict``, reference optimizer.py:719-727). COLLECTIVE:
+        every process must call it (the gather is a mesh collective on a
+        multi-process mesh); every process returns the same full host tensors."""
+        tensors = self.bridge.gather_to_host(self._state_leaves())
+        return {"epoch": int(self.local_epoch), "tensors": tensors}
+
+    def load_state_dict(self, state: dict) -> None:
+        """Restore a checkpoint onto the sharded device state. COLLECTIVE: every
+        process must call it with the same checkpoint."""
+        self._adopt_checkpoint(
+            [np.asarray(t, np.float32) for t in state["tensors"]], int(state["epoch"])
+        )
+
+    def force_epoch_transition(self, num_peers: int = 1) -> None:
+        """Run the collective epoch transition NOW with whatever has accumulated —
+        the deterministic alternative to waiting for the tracker's async fetch
+        (tests, drills, graceful drain before shutdown). COLLECTIVE: every process
+        must call it; ``num_peers`` > 1 additionally attempts the swarm rounds."""
+        with self._step_lock:
+            self._collective_epoch_update(num_peers)
 
     def load_state_from_peers(self, timeout: Optional[float] = None) -> bool:
         """Explicit collective state download (every process must call this)."""
