@@ -531,9 +531,14 @@ class SliceOptimizer:
         self.local_epoch = epoch
         self._accum = self._jit_zeros_like()(self.params)
         self._samples = 0
-        self._refresh_state_mirrors()
         if self.is_network_process:
             assert self.state_averager is not None and self.tracker is not None
+            # the adopted host tensors ARE the new state: restage the download
+            # mirrors from them directly — no redundant device→host gather of
+            # what was just scattered
+            with self.state_averager.get_tensors() as mirrors:
+                for mirror, tensor, leaf in zip(mirrors, tensors, state_leaves):
+                    np.copyto(mirror, np.asarray(tensor, np.float32).reshape(leaf.shape))
             self.state_averager.state_sharing_priority = epoch
             self.tracker.report_local_progress(epoch, 0)
 
@@ -580,16 +585,21 @@ class SliceOptimizer:
         """User-level checkpoint with the epoch embedded (API parity with
         ``Optimizer.state_dict``, reference optimizer.py:719-727). COLLECTIVE:
         every process must call it (the gather is a mesh collective on a
-        multi-process mesh); every process returns the same full host tensors."""
-        tensors = self.bridge.gather_to_host(self._state_leaves())
-        return {"epoch": int(self.local_epoch), "tensors": tensors}
+        multi-process mesh); every process returns the same full host tensors.
+        Takes the step lock so a checkpoint can never capture a torn mid-epoch
+        state (params advanced but epoch not yet)."""
+        with self._step_lock:
+            tensors = self.bridge.gather_to_host(self._state_leaves())
+            return {"epoch": int(self.local_epoch), "tensors": tensors}
 
     def load_state_dict(self, state: dict) -> None:
         """Restore a checkpoint onto the sharded device state. COLLECTIVE: every
-        process must call it with the same checkpoint."""
-        self._adopt_checkpoint(
-            [np.asarray(t, np.float32) for t in state["tensors"]], int(state["epoch"])
-        )
+        process must call it with the same checkpoint. Takes the step lock — a
+        restore racing a training step would swap the param tree under it."""
+        with self._step_lock:
+            self._adopt_checkpoint(
+                [np.asarray(t, np.float32) for t in state["tensors"]], int(state["epoch"])
+            )
 
     def force_epoch_transition(self, num_peers: int = 1) -> None:
         """Run the collective epoch transition NOW with whatever has accumulated —

@@ -249,23 +249,28 @@ def test_slice_optimizer_state_dict_roundtrip():
         assert len(checkpoint["tensors"]) == 3  # params + adam mu + nu
         trained = np.asarray(jax.device_get(opt.params["w"]))
 
+        # a DIFFERENT run_id: the restore target must not share a swarm with the
+        # original — otherwise the original's tracker records can flip the
+        # restored peer into the catch-up path mid-comparison (it would download
+        # state instead of applying its gradient, making the adam assertion
+        # vacuous) and 2-peer trackers would try real averaging rounds
         fresh = SliceOptimizer(
             mesh=mesh, params={"w": jax.device_put(np.zeros((8, 4), np.float32), sharding)},
             optimizer=optax.adam(0.1),
             dht_factory=lambda: DHT(
                 initial_peers=[str(m) for m in boot.get_visible_maddrs()], start=True
             ),
-            run_id="ckpt_rt", target_batch_size=8, batch_size_per_step=8,
+            run_id="ckpt_rt_restored", target_batch_size=8, batch_size_per_step=8,
         )
         fresh.load_state_dict(checkpoint)
         assert fresh.local_epoch == checkpoint["epoch"]
         np.testing.assert_allclose(
             np.asarray(jax.device_get(fresh.params["w"])), trained, atol=1e-6
         )
-        # adam statistics restored: one identical epoch update on both sides must
-        # produce identical params (force the transition — deterministic, no
-        # tracker timing; if step() already transitioned, exactly one update of g
-        # was applied either way)
+        # adam statistics restored: one identical (solo, local-gradient) epoch
+        # update on both sides must produce identical params. Exactly ONE
+        # transition each: if step() already fired it via the tracker, forcing a
+        # second would apply a spurious zero-grad adam update
         for instance in (opt, fresh):
             before = instance.local_epoch
             instance.step(g, batch_size=8)
