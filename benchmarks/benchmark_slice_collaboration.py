@@ -57,11 +57,12 @@ def main():
     from hivemind_tpu.optim import Optimizer, SliceOptimizer
     from hivemind_tpu.parallel import make_mesh, params_shardings
 
-    # dp×tp×sp factorization of the mesh (same scheme as __graft_entry__)
+    # dp×tp×sp factorization: peel one factor of 2 each for sp and tp, the rest
+    # (including odd leftovers) goes to data parallel — works for any device count
     n = args.num_devices
-    dp, tp, sp = max(n // 4, 1), min(2, n // 2 or 1), min(2, n // 4 or 1)
-    while dp * tp * sp < n:
-        dp *= 2
+    sp = 2 if n % 2 == 0 else 1
+    tp = 2 if (n // sp) % 2 == 0 else 1
+    dp = n // (sp * tp)
     assert dp * tp * sp == n, (dp, tp, sp)
     mesh = make_mesh(dp=dp, tp=tp, sp=sp)
     config = AlbertConfig.tiny(mesh=mesh, num_heads=4)

@@ -40,6 +40,10 @@ host-resident :class:`hivemind_tpu.optim.Optimizer` peers, so slices, GPU boxes 
 laptops share one swarm. Its advertised bandwidth is the slice's aggregate egress
 (host count × base), as in :class:`MeshAverager`.
 
+Gradient compression composes: ``grad_averager_factory`` accepts e.g.
+``PowerSGDGradientAverager`` — the rank-r P/Q phases run on the staged host
+gradients on process 0, wire-compatible with host PowerSGD peers in the same run.
+
 Deviations from the host Optimizer (documented, not silent): no delayed parameter
 updates (DPU backgrounds the transition on a thread, which would break the
 collective contract — every process must enter the same collectives in the same
@@ -59,6 +63,7 @@ import numpy as np
 from hivemind_tpu.averaging.averager import DecentralizedAverager
 from hivemind_tpu.averaging.control import StepControl
 from hivemind_tpu.compression import CompressionBase, Float16Compression
+from hivemind_tpu.optim.grad_averager import GradientAverager
 from hivemind_tpu.optim.progress_tracker import ProgressTracker
 from hivemind_tpu.parallel.ici import MeshTensorBridge
 from hivemind_tpu.utils.logging import get_logger
@@ -126,6 +131,7 @@ class SliceOptimizer:
         target_group_size: Optional[int] = None,
         min_group_size: int = 2,
         bandwidth: Optional[float] = None,
+        grad_averager_factory=None,
         verbose: bool = False,
         **averager_opts,
     ):
@@ -205,7 +211,13 @@ class SliceOptimizer:
             grad_templates = [
                 np.zeros(leaf.shape, np.float32) for leaf in self._params_leaves
             ]
-            self.grad_averager = DecentralizedAverager(
+            # grad_averager_factory (API parity with the host Optimizer): e.g.
+            # PowerSGDGradientAverager for rank-r compressed swarm rounds — the
+            # P/Q phases run on the staged host gradients on process 0, so the
+            # slice interoperates with host PowerSGD peers on the same run_id.
+            # The factory must accept (templates, dht=..., prefix=..., ...)
+            factory = grad_averager_factory if grad_averager_factory is not None else DecentralizedAverager
+            self.grad_averager = factory(
                 grad_templates,
                 prefix=f"{run_id}_grad_averager",
                 compression=grad_compression,
@@ -303,12 +315,20 @@ class SliceOptimizer:
         assert self.tracker is not None and self.grad_averager is not None
         eta = self.tracker.global_progress.eta_next_epoch - get_dht_time()
         if eta <= self.matchmaking_time * 2 and self._scheduled_control_invalid():
-            self.scheduled_grads = self.grad_averager.step(
-                scheduled_time=get_dht_time() + max(eta, 1e-2),
-                timeout=self.averaging_timeout,
-                require_trigger=True,
-                wait=False,
-            )
+            scheduled_time = get_dht_time() + max(eta, 1e-2)
+            if isinstance(self.grad_averager, GradientAverager):
+                # its step() override hardcodes require_trigger; use the dedicated
+                # scheduling entry point (same as the host Optimizer)
+                self.scheduled_grads = self.grad_averager.schedule_step(
+                    scheduled_time=scheduled_time, timeout=self.averaging_timeout
+                )
+            else:
+                self.scheduled_grads = self.grad_averager.step(
+                    scheduled_time=scheduled_time,
+                    timeout=self.averaging_timeout,
+                    require_trigger=True,
+                    wait=False,
+                )
             logger.debug(f"pre-scheduled slice gradient averaging in {eta:.1f}s")
 
     def _scheduled_control_invalid(self) -> bool:
@@ -348,11 +368,16 @@ class SliceOptimizer:
                         control.allow_allreduce()
                         result = control.result(self.averaging_timeout)
                     else:
-                        result = self.grad_averager.step(
+                        step_kwargs = dict(
                             weight=weight,
                             timeout=self.averaging_timeout,
                             scheduled_time=get_dht_time() + self.matchmaking_time,
                         )
+                        if isinstance(self.grad_averager, GradientAverager):
+                            # the gradients are ALREADY staged in the shared
+                            # tensors — its host accumulators must not overwrite
+                            step_kwargs.update(load_accumulators=False)
+                        result = self.grad_averager.step(**step_kwargs)
                     averaged_ok = result is not None
                 except Exception as e:
                     logger.warning(f"slice gradient averaging failed ({e!r}); applying local gradients")
@@ -587,7 +612,10 @@ class SliceOptimizer:
         every process must call it (the gather is a mesh collective on a
         multi-process mesh); every process returns the same full host tensors.
         Takes the step lock so a checkpoint can never capture a torn mid-epoch
-        state (params advanced but epoch not yet)."""
+        state (params advanced but epoch not yet). NOTE: the lock covers
+        concurrent threads WITHIN one process only — on a multi-process mesh all
+        collective calls (step/checkpoint/restore) must come from one thread per
+        process in the same order, or the processes' collectives mismatch."""
         with self._step_lock:
             tensors = self.bridge.gather_to_host(self._state_leaves())
             return {"epoch": int(self.local_epoch), "tensors": tensors}
@@ -595,7 +623,9 @@ class SliceOptimizer:
     def load_state_dict(self, state: dict) -> None:
         """Restore a checkpoint onto the sharded device state. COLLECTIVE: every
         process must call it with the same checkpoint. Takes the step lock — a
-        restore racing a training step would swap the param tree under it."""
+        restore racing a training step in another thread would swap the param
+        tree under it (single-process protection only; see ``state_dict``'s
+        multi-process ordering note)."""
         with self._step_lock:
             self._adopt_checkpoint(
                 [np.asarray(t, np.float32) for t in state["tensors"]], int(state["epoch"])

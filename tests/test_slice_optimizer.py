@@ -284,3 +284,82 @@ def test_slice_optimizer_state_dict_roundtrip():
         if fresh is not None:
             fresh.shutdown()
         opt.shutdown()
+
+
+def test_slice_optimizer_with_powersgd_interoperates_with_host_peer():
+    """PowerSGD gradient compression on the slice tier: a SliceOptimizer with a
+    PowerSGDGradientAverager factory trains in lockstep with a host Optimizer
+    peer using the same factory. Constant gradients are exactly rank-1, so the
+    factorized rounds are lossless and both peers must land on the exact
+    large-batch average — and on each other."""
+    import threading
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from hivemind_tpu.dht import DHT
+    from hivemind_tpu.optim import Optimizer, PowerSGDGradientAverager, SliceOptimizer
+
+    mesh = Mesh(np.array(jax.devices()).reshape(len(jax.devices())), ("dp",))
+    sharding = NamedSharding(mesh, P("dp"))
+    LR, TARGET = 0.1, 32
+    factory = lambda templates, **kw: PowerSGDGradientAverager(templates, averager_rank=1, **kw)
+
+    boot = DHT(start=True)
+    slice_opt = SliceOptimizer(
+        mesh=mesh, params={"w": jax.device_put(np.zeros((8, 16), np.float32), sharding)},
+        optimizer=optax.sgd(LR), dht_factory=lambda: boot,
+        run_id="psgd_slice", target_batch_size=TARGET, batch_size_per_step=8,
+        target_group_size=2, matchmaking_time=1.5, averaging_timeout=40.0,
+        grad_averager_factory=factory,
+    )
+    q_seed = np.array(slice_opt.grad_averager._qs[0])  # warm-start Q before any round
+    host_dht = DHT(initial_peers=[str(m) for m in boot.get_visible_maddrs()], start=True)
+    host_opt = Optimizer(
+        dht=host_dht, run_id="psgd_slice", params={"w": jnp.zeros((8, 16))},
+        optimizer=optax.sgd(LR), target_batch_size=TARGET, batch_size_per_step=8,
+        target_group_size=2, matchmaking_time=1.5, averaging_timeout=40.0,
+        grad_averager_factory=factory,
+    )
+    g_slice = {"w": jax.device_put(np.full((8, 16), 2.0, np.float32), sharding)}
+    g_host = {"w": jnp.full((8, 16), 4.0)}
+    EPOCHS = 2
+    stop = threading.Event()
+
+    def host_loop():
+        while not stop.is_set() and host_opt.local_epoch < EPOCHS:
+            host_opt.step(g_host, batch_size=8)
+            time.sleep(0.2)
+
+    thread = threading.Thread(target=host_loop, daemon=True)
+    thread.start()
+    try:
+        deadline = time.monotonic() + 180
+        while slice_opt.local_epoch < EPOCHS and time.monotonic() < deadline:
+            slice_opt.step(g_slice, batch_size=8)
+            time.sleep(0.2)
+        assert slice_opt.local_epoch >= EPOCHS, f"stuck at {slice_opt.local_epoch}"
+        epochs = slice_opt.local_epoch
+        sw = np.asarray(jax.device_get(slice_opt.params["w"]))
+        hw = np.asarray(jax.device_get(host_opt.params["w"]))
+        # both peers ADOPT the same factorized group average every epoch, so they
+        # must agree exactly — regardless of how the sample split landed; the
+        # value itself sits between the all-slice and all-host extremes (the
+        # weighted mean of grads 2.0 and 4.0)
+        np.testing.assert_allclose(sw, hw, atol=5e-3)
+        assert (-LR * 4.0 * epochs - 5e-3) <= sw[0, 0] <= (-LR * 2.0 * epochs + 5e-3), sw[0, 0]
+        # the compressed rounds really happened: a successful P/Q round replaces
+        # the warm-start Q (seeded 0xC0FFEE) with the orthogonalized average
+        assert not np.allclose(slice_opt.grad_averager._qs[0], q_seed), (
+            "warm-start Q unchanged: no factorized round ever completed"
+        )
+    finally:
+        stop.set()
+        thread.join(timeout=60)
+        slice_opt.shutdown()
+        host_opt.shutdown()
+        host_dht.shutdown()
