@@ -45,9 +45,14 @@ class GradientAverager(DecentralizedAverager):
     ):
         self.reuse_grad_buffers = reuse_grad_buffers
         templates = [as_numpy(t) for t in tensors_like]
-        self._grad_accumulators: List[np.ndarray] = [
-            np.zeros(t.shape, np.float32) for t in templates
-        ]
+        # accumulate_grads_on_host=False skips the host accumulator allocation (a
+        # full model copy) for callers that stage gradients straight into the
+        # shared tensors — e.g. SliceOptimizer, whose accumulation lives on device
+        self._grad_accumulators: Optional[List[np.ndarray]] = (
+            [np.zeros(t.shape, np.float32) for t in templates]
+            if accumulate_grads_on_host
+            else None
+        )
         self.local_samples_accumulated = 0
         self.local_times_accumulated = 0
         self._new_averaged_grads = False
@@ -62,6 +67,10 @@ class GradientAverager(DecentralizedAverager):
         """Add one microbatch's gradients (jax or numpy arrays, already averaged over
         the microbatch) scaled by its size (reference grad_averager.py:129-148)."""
         grads = list(grads)
+        assert self._grad_accumulators is not None, (
+            "this averager was built with accumulate_grads_on_host=False — "
+            "gradients are staged externally into the shared tensors"
+        )
         assert len(grads) == len(self._grad_accumulators), (
             f"got {len(grads)} gradient tensors, expected {len(self._grad_accumulators)}"
         )
@@ -113,6 +122,9 @@ class GradientAverager(DecentralizedAverager):
     def load_accumulators_into_averager_(self) -> None:
         """Normalize accumulators by sample count and copy into the shared tensors
         (reference grad_averager.py:203-210)."""
+        assert self._grad_accumulators is not None, (
+            "accumulate_grads_on_host=False: stage into the shared tensors directly"
+        )
         denominator = max(self.local_samples_accumulated, 1)
         with self.get_tensors() as tensors:
             for tensor, accumulator in zip(tensors, self._grad_accumulators):
@@ -120,8 +132,9 @@ class GradientAverager(DecentralizedAverager):
         self._new_averaged_grads = True
 
     def reset_accumulated_grads_(self) -> None:
-        for accumulator in self._grad_accumulators:
-            accumulator.fill(0.0)
+        if self._grad_accumulators is not None:
+            for accumulator in self._grad_accumulators:
+                accumulator.fill(0.0)
         self.local_samples_accumulated = 0
         self.local_times_accumulated = 0
 

@@ -215,12 +215,23 @@ class SliceOptimizer:
             # PowerSGDGradientAverager for rank-r compressed swarm rounds — the
             # P/Q phases run on the staged host gradients on process 0, so the
             # slice interoperates with host PowerSGD peers on the same run_id.
-            # The factory must accept (templates, dht=..., prefix=..., ...)
+            # The factory must accept (templates, dht=..., prefix=..., ...).
+            # When it resolves to a GradientAverager subclass (class or
+            # functools.partial of one), host accumulators are skipped — the
+            # slice accumulates on device and stages directly, so they would be
+            # a wasted model copy of host RAM.
             factory = grad_averager_factory if grad_averager_factory is not None else DecentralizedAverager
+            factory_class = factory if isinstance(factory, type) else getattr(factory, "func", None)
+            extra_opts = (
+                {"accumulate_grads_on_host": False}
+                if isinstance(factory_class, type) and issubclass(factory_class, GradientAverager)
+                else {}
+            )
             self.grad_averager = factory(
                 grad_templates,
                 prefix=f"{run_id}_grad_averager",
                 compression=grad_compression,
+                **extra_opts,
                 **common,
             )
             state_templates = [
@@ -363,21 +374,30 @@ class SliceOptimizer:
                 self.scheduled_grads = None
                 try:
                     weight = float(max(self._samples, 1))
-                    if control is not None:
+                    if isinstance(self.grad_averager, GradientAverager):
+                        # one call covers scheduled and unscheduled (the host
+                        # Optimizer's DPU path, optimizer.py:430-436); gradients
+                        # are ALREADY staged in the shared tensors, so the host
+                        # accumulators must not overwrite them
+                        result = self.grad_averager.step(
+                            control=control,
+                            weight=weight,
+                            timeout=self.averaging_timeout,
+                            load_accumulators=False,
+                            scheduled_time=(
+                                get_dht_time() + self.matchmaking_time if control is None else None
+                            ),
+                        )
+                    elif control is not None:
                         control.weight = weight
                         control.allow_allreduce()
                         result = control.result(self.averaging_timeout)
                     else:
-                        step_kwargs = dict(
+                        result = self.grad_averager.step(
                             weight=weight,
                             timeout=self.averaging_timeout,
                             scheduled_time=get_dht_time() + self.matchmaking_time,
                         )
-                        if isinstance(self.grad_averager, GradientAverager):
-                            # the gradients are ALREADY staged in the shared
-                            # tensors — its host accumulators must not overwrite
-                            step_kwargs.update(load_accumulators=False)
-                        result = self.grad_averager.step(**step_kwargs)
                     averaged_ok = result is not None
                 except Exception as e:
                     logger.warning(f"slice gradient averaging failed ({e!r}); applying local gradients")

@@ -756,6 +756,66 @@ def test_decode_failover_mid_generation_matches_uninterrupted_run():
                 server.dht.shutdown()
 
 
+def test_decode_failover_with_span_groups():
+    """Failover across SPAN-grouped routes: two servers each hosting a 2-block
+    span; the second dies mid-generation and the replacement (same uids, seed-0
+    weights) is re-prefilled THROUGH the span RPC — emitted positions identical
+    to the uninterrupted run, and the recovered route still groups 2+2."""
+    import time
+    import uuid
+    from hivemind_tpu.moe import RemoteSequential
+
+    server_a = Server.create(
+        expert_uids=["fs.0", "fs.1"], expert_cls="causal_transformer", hidden_dim=16,
+        start=True, optim_factory=lambda: optax.sgd(1e-4),
+    )
+    maddrs = [str(m) for m in server_a.dht.get_visible_maddrs()]
+    server_b = Server.create(
+        expert_uids=["fs.2", "fs.3"], expert_cls="causal_transformer", hidden_dim=16,
+        dht=None, start=True, optim_factory=lambda: optax.sgd(1e-4), initial_peers=maddrs,
+    )
+    client_dht = server_b2 = None
+    try:
+        time.sleep(1.5)
+        client_dht = DHT(initial_peers=maddrs, start=True)
+        pipe = RemoteSequential(client_dht, "fs.", 4, max_retries=4)
+
+        rng = np.random.RandomState(9)
+        hidden = rng.randn(1, 7, 16).astype(np.float32)
+        prompt = 4
+
+        ref_session = uuid.uuid4().hex
+        ref = [pipe.decode_step(hidden[:, :prompt], ref_session, reset=True)]
+        ref += [pipe.decode_step(hidden[:, t:t + 1], ref_session) for t in range(prompt, 7)]
+
+        session = uuid.uuid4().hex
+        outs = [pipe.decode_step(hidden[:, :prompt], session, reset=True)]
+        outs.append(pipe.decode_step(hidden[:, prompt:prompt + 1], session))
+        assert [len(span) for _b, span in pipe._decode_routes[session]["route"]] == [2, 2]
+
+        server_b.shutdown()
+        server_b.dht.shutdown()
+        server_b = None  # intentionally dead: keep it out of the finally sweep
+        server_b2 = Server.create(
+            expert_uids=["fs.2", "fs.3"], expert_cls="causal_transformer", hidden_dim=16,
+            dht=None, start=True, optim_factory=lambda: optax.sgd(1e-4), initial_peers=maddrs,
+        )
+        time.sleep(1.5)
+        outs += [pipe.decode_step(hidden[:, t:t + 1], session) for t in (prompt + 1, prompt + 2)]
+
+        for i, (expected, got) in enumerate(zip(ref, outs)):
+            np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-5,
+                                       err_msg=f"position group {i} diverged after span failover")
+        assert [len(span) for _b, span in pipe._decode_routes[session]["route"]] == [2, 2]
+    finally:
+        if client_dht is not None:
+            client_dht.shutdown()
+        for server in (server_b2, server_b, server_a):
+            if server is not None:
+                server.shutdown()
+                server.dht.shutdown()
+
+
 def test_span_fallback_for_span_unaware_server():
     """Mixed-swarm capability negotiation: when a server does not advertise
     span_support (an older build would run only the head block and silently

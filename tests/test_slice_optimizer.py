@@ -304,10 +304,14 @@ def test_slice_optimizer_with_powersgd_interoperates_with_host_peer():
     from hivemind_tpu.dht import DHT
     from hivemind_tpu.optim import Optimizer, PowerSGDGradientAverager, SliceOptimizer
 
+    import functools
+
     mesh = Mesh(np.array(jax.devices()).reshape(len(jax.devices())), ("dp",))
     sharding = NamedSharding(mesh, P("dp"))
     LR, TARGET = 0.1, 32
-    factory = lambda templates, **kw: PowerSGDGradientAverager(templates, averager_rank=1, **kw)
+    # a partial (not a lambda) lets SliceOptimizer see the class and skip the
+    # host accumulator allocation (its accumulation lives on device)
+    factory = functools.partial(PowerSGDGradientAverager, averager_rank=1)
 
     boot = DHT(start=True)
     slice_opt = SliceOptimizer(
@@ -344,6 +348,16 @@ def test_slice_optimizer_with_powersgd_interoperates_with_host_peer():
             time.sleep(0.2)
         assert slice_opt.local_epoch >= EPOCHS, f"stuck at {slice_opt.local_epoch}"
         epochs = slice_opt.local_epoch
+        # the slice loop exits the moment IT transitions; let the host finish its
+        # own epoch-2 transition before comparing (its thread stops itself there)
+        settle = time.monotonic() + 60
+        while host_opt.local_epoch < epochs and time.monotonic() < settle:
+            time.sleep(0.2)
+        stop.set()
+        thread.join(timeout=60)
+        assert host_opt.local_epoch >= epochs, f"host stuck at {host_opt.local_epoch}"
+        # the device-side accumulation path really skipped the host buffers
+        assert slice_opt.grad_averager._grad_accumulators is None
         sw = np.asarray(jax.device_get(slice_opt.params["w"]))
         hw = np.asarray(jax.device_get(host_opt.params["w"]))
         # both peers ADOPT the same factorized group average every epoch, so they
