@@ -28,6 +28,7 @@ import numpy as np
 from hivemind_tpu.averaging.control import AveragingStage, StepControl
 from hivemind_tpu.compression import CompressionBase, Float16Compression, NoCompression
 from hivemind_tpu.dht import DHT
+from hivemind_tpu.optim.chronic import ChronicFailureTracking
 from hivemind_tpu.optim.grad_averager import GradientAverager
 from hivemind_tpu.optim.progress_tracker import ProgressTracker
 from hivemind_tpu.optim.state_averager import TrainingStateAverager
@@ -37,7 +38,7 @@ from hivemind_tpu.utils.timed_storage import get_dht_time
 logger = get_logger(__name__)
 
 
-class Optimizer:
+class Optimizer(ChronicFailureTracking):
     """See module docstring.
 
     :param run_id: unique swarm identifier; peers with the same run_id train together
@@ -339,42 +340,7 @@ class Optimizer:
         self.grad_averager.reset_accumulated_grads_()
         self._finish_epoch_transition(next_epoch, averaged_ok)
 
-    @property
-    def consecutive_failed_averaging_rounds(self) -> int:
-        """Epochs in a row that fell back to local gradients (0 = healthy)."""
-        return self._consecutive_failed_rounds
-
-    @property
-    def chronic_averaging_failure(self) -> bool:
-        """True once `chronic_failure_threshold` consecutive epochs degraded to
-        local SGD — the swarm is effectively unreachable for this peer."""
-        return self._consecutive_failed_rounds >= self.chronic_failure_threshold
-
-    def _record_round_outcome(self, averaged_ok: Optional[bool]) -> None:
-        if averaged_ok is None:
-            return  # no round was attempted (solo swarm): neither failure nor recovery
-        if averaged_ok:
-            if self.chronic_averaging_failure:
-                logger.info("swarm averaging recovered after "
-                            f"{self._consecutive_failed_rounds} failed epochs")
-            self._consecutive_failed_rounds = 0
-            return
-        self._consecutive_failed_rounds += 1
-        if self._consecutive_failed_rounds == self.chronic_failure_threshold:
-            logger.error(
-                f"{self._consecutive_failed_rounds} consecutive epochs degraded to local "
-                f"gradients — this peer is training local SGD, not collaborating; check "
-                f"connectivity/matchmaking (backing off matchmaking exponentially)"
-            )
-
-    def _matchmaking_delay(self) -> float:
-        """Matchmaking lead time, exponentially backed off under chronic failure
-        (cap 8×): a peer that cannot form groups should not hammer the DHT with
-        declare/fetch cycles at full cadence."""
-        excess = self._consecutive_failed_rounds - self.chronic_failure_threshold
-        if excess < 0:
-            return self.matchmaking_time
-        return self.matchmaking_time * min(2.0 ** (excess + 1), 8.0)
+    # chronic counter/backoff/log members come from ChronicFailureTracking
 
     def _finish_epoch_transition(self, next_epoch: int, averaged_ok: Optional[bool]) -> None:
         """``averaged_ok``: True/False for an attempted swarm round, None when no

@@ -63,6 +63,7 @@ import numpy as np
 from hivemind_tpu.averaging.averager import DecentralizedAverager
 from hivemind_tpu.averaging.control import StepControl
 from hivemind_tpu.compression import CompressionBase, Float16Compression
+from hivemind_tpu.optim.chronic import ChronicFailureTracking
 from hivemind_tpu.optim.grad_averager import GradientAverager
 from hivemind_tpu.optim.progress_tracker import ProgressTracker
 from hivemind_tpu.parallel.ici import MeshTensorBridge
@@ -93,7 +94,7 @@ class _SliceStateAverager(DecentralizedAverager):
         return {"epoch": int(self._epoch_fn())}, self._snapshot_tensors()
 
 
-class SliceOptimizer:
+class SliceOptimizer(ChronicFailureTracking):
     """See module docstring.
 
     :param mesh: the global Mesh (possibly spanning several processes/hosts)
@@ -110,6 +111,8 @@ class SliceOptimizer:
     :param average_opt_statistics: also average floating optimizer-state leaves
         (must match the host peers' setting or the state schemas diverge)
     """
+
+    _chronic_peer_noun = "slice"
 
     def __init__(
         self,
@@ -132,6 +135,7 @@ class SliceOptimizer:
         min_group_size: int = 2,
         bandwidth: Optional[float] = None,
         grad_averager_factory=None,
+        chronic_failure_threshold: int = 5,
         verbose: bool = False,
         **averager_opts,
     ):
@@ -171,6 +175,12 @@ class SliceOptimizer:
         self._samples = 0
         self.local_epoch = 0
         self.scheduled_grads: Optional[StepControl] = None
+        # chronic-degradation tracking (host Optimizer parity, optimizer.py:100-136):
+        # epochs that fell back to local gradients count; past the threshold the
+        # condition escalates to ERROR and matchmaking backs off exponentially.
+        # Tracked consistently on EVERY process — the outcome flag is broadcast.
+        self.chronic_failure_threshold = chronic_failure_threshold
+        self._consecutive_failed_rounds = 0
 
         import optax
 
@@ -320,10 +330,16 @@ class SliceOptimizer:
 
     # ------------------------------------------------------------------ scheduling
 
+    # chronic counter/backoff/log members come from ChronicFailureTracking
+
     def _maybe_schedule_gradient_averaging(self) -> None:
         """Pre-schedule matchmaking so the group is formed when the swarm hits the
         target (reference optimizer.py:559-567). Network process only, no collective."""
         assert self.tracker is not None and self.grad_averager is not None
+        if self.chronic_averaging_failure:
+            # pre-scheduling re-declares in the DHT at full cadence every step;
+            # under chronic failure only the (backed-off) step-time path matchmakes
+            return
         eta = self.tracker.global_progress.eta_next_epoch - get_dht_time()
         if eta <= self.matchmaking_time * 2 and self._scheduled_control_invalid():
             scheduled_time = get_dht_time() + max(eta, 1e-2)
@@ -385,7 +401,7 @@ class SliceOptimizer:
                             timeout=self.averaging_timeout,
                             load_accumulators=False,
                             scheduled_time=(
-                                get_dht_time() + self.matchmaking_time if control is None else None
+                                get_dht_time() + self._matchmaking_delay() if control is None else None
                             ),
                         )
                     elif control is not None:
@@ -396,7 +412,7 @@ class SliceOptimizer:
                         result = self.grad_averager.step(
                             weight=weight,
                             timeout=self.averaging_timeout,
-                            scheduled_time=get_dht_time() + self.matchmaking_time,
+                            scheduled_time=get_dht_time() + self._matchmaking_delay(),
                         )
                     averaged_ok = result is not None
                 except Exception as e:
@@ -431,6 +447,9 @@ class SliceOptimizer:
 
         # phase E (collective): refresh the state mirrors every epoch (downloads
         # stay ≤1 epoch stale) and run the periodic state averaging round
+        # record the grad-round outcome FIRST (reference order, optimizer.py:384-388):
+        # the state phase's matchmaking delay must see the recovered counter
+        self._record_round_outcome(averaged_ok)
         self._collective_state_phase(next_epoch, num_peers)
 
         self.local_epoch = next_epoch
@@ -471,7 +490,7 @@ class SliceOptimizer:
                 ok = (
                     self.state_averager.step(
                         timeout=self.averaging_timeout,
-                        scheduled_time=get_dht_time() + self.matchmaking_time,
+                        scheduled_time=get_dht_time() + self._matchmaking_delay(),
                     )
                     is not None
                 )

@@ -377,3 +377,37 @@ def test_slice_optimizer_with_powersgd_interoperates_with_host_peer():
         slice_opt.shutdown()
         host_opt.shutdown()
         host_dht.shutdown()
+
+
+def test_slice_chronic_failure_counter_and_backoff():
+    """Host-Optimizer parity (optimizer.py:100-136): consecutive failed swarm
+    rounds escalate to chronic failure, matchmaking lead time backs off
+    exponentially (capped 8x), pre-scheduling is suppressed while chronic, and
+    one success resets everything. Pure unit math — no network."""
+    from hivemind_tpu.optim import SliceOptimizer
+
+    opt = SliceOptimizer.__new__(SliceOptimizer)
+    opt.matchmaking_time = 2.0
+    opt.chronic_failure_threshold = 3
+    opt._consecutive_failed_rounds = 0
+    opt.is_network_process = True
+
+    assert not opt.chronic_averaging_failure
+    assert opt._matchmaking_delay() == 2.0
+    opt._record_round_outcome(None)  # solo swarm: neither failure nor recovery
+    assert opt.consecutive_failed_averaging_rounds == 0
+
+    for _ in range(3):
+        opt._record_round_outcome(False)
+    assert opt.chronic_averaging_failure
+    assert opt._matchmaking_delay() == 4.0  # 2.0 * 2^1
+    opt._record_round_outcome(False)
+    assert opt._matchmaking_delay() == 8.0
+    for _ in range(10):
+        opt._record_round_outcome(False)
+    assert opt._matchmaking_delay() == 16.0  # capped at 8x
+
+    opt._record_round_outcome(True)  # recovery resets
+    assert opt.consecutive_failed_averaging_rounds == 0
+    assert not opt.chronic_averaging_failure
+    assert opt._matchmaking_delay() == 2.0
