@@ -136,11 +136,15 @@ def test_run_trainer_two_peer_smoke():
             stderr=subprocess.PIPE, text=True, cwd=repo, env=env,
         )
 
+        # generous deadlines: at the tail of a full-suite run this test shares
+        # the one core with compile-heavy neighbors and can legitimately take
+        # several minutes (it passes alone in ~1) — a timeout here is a flake,
+        # not a hang signal
         second = subprocess.run(
             common + ["--seed", "1", "--initial_peers", maddr],
-            stderr=subprocess.PIPE, text=True, cwd=repo, timeout=240, env=env,
+            stderr=subprocess.PIPE, text=True, cwd=repo, timeout=420, env=env,
         )
-        first_err = first.communicate(timeout=120)[1]
+        first_err = first.communicate(timeout=240)[1]
         logs = "".join(lines) + (first_err or "") + (second.stderr or "")
         assert second.returncode == 0, logs[-3000:]
         assert first.returncode == 0, logs[-3000:]
