@@ -369,6 +369,66 @@ def _probe_point(label: str, probe_log: list, attempts: int) -> bool:
     return reachable
 
 
+_COMPACT_EXTRA_KEYS = (
+    "device", "mfu", "batch_size", "remat", "seq_len", "final_loss",
+    "attention", "masked_loss_fraction", "averaging_gbps_per_peer",
+)
+# least-important-first drop order when the compact line must shrink to fit
+_COMPACT_DROP_ORDER = (
+    "tpu_probes", "masked_loss_fraction", "attention", "final_loss", "remat",
+    "batch_size", "seq_len", "device", "averaging_gbps_per_peer", "mfu",
+)
+
+
+def compact_result(result: dict, max_chars: int = 1500) -> str:
+    """The final-stdout-line JSON: metric-first, guaranteed under ``max_chars``.
+
+    The round driver records only the last ~2000 chars of output; round 4's
+    artifact embedded the probe log inside the single JSON line and truncated
+    away its own metric (VERDICT r4 weak #1). The headline fields therefore go
+    FIRST and the line degrades by dropping optional extras, never the metric."""
+    extra = result.get("extra") or {}
+    compact = {
+        "metric": result.get("metric"),
+        "value": result.get("value"),
+        "unit": result.get("unit"),
+        "vs_baseline": result.get("vs_baseline"),
+    }
+    for flag in ("tpu_unavailable", "fallback"):
+        if flag in result:
+            compact[flag] = result[flag]
+    compact_extra = {
+        k: extra[k] for k in _COMPACT_EXTRA_KEYS if extra.get(k) is not None
+    }
+    probe_log = result.get("tpu_probe_log")
+    if probe_log:
+        compact_extra["tpu_probes"] = [
+            {"when": p.get("when"), "reachable": p.get("reachable")} for p in probe_log
+        ]
+    compact["extra"] = compact_extra
+    line = json.dumps(compact)
+    for drop in _COMPACT_DROP_ORDER:
+        if len(line) <= max_chars:
+            break
+        compact_extra.pop(drop, None)
+        line = json.dumps(compact)
+    if len(line) > max_chars:
+        compact.pop("extra", None)
+        line = json.dumps(compact)
+    return line
+
+
+def emit(result: dict, out=None, err=None) -> None:
+    """Full diagnostics (probe log, controls, errors) go to stderr; stdout's final
+    line is the compact metric-first JSON the driver records."""
+    import sys
+
+    out = sys.stdout if out is None else out
+    err = sys.stderr if err is None else err
+    print(json.dumps(result), file=err, flush=True)
+    print(compact_result(result), file=out, flush=True)
+
+
 def main() -> None:
     diagnostics: list = []
     probe_log: list = []
@@ -401,7 +461,7 @@ def main() -> None:
     result["tpu_probe_log"] = probe_log
     if diagnostics:
         result["tpu_measure_errors"] = diagnostics
-    print(json.dumps(result))
+    emit(result)
 
 
 if __name__ == "__main__":

@@ -79,6 +79,12 @@ class Matchmaking:
         # without an operator re-sizing the lead time.
         self.fill_latency_ema: Optional[float] = None
         self._lead_backoff = 1.0
+        # set whenever another declared averager (or an inbound join request) is
+        # seen during the current window: a group-less round with NOBODY to match
+        # with is the legitimate solo-swarm case and must not ratchet the backoff
+        # (advisor r4: a peer starting before its swarm would otherwise arrive at
+        # the 30 s cap and slow its first real group formation)
+        self._others_observed = False
 
     def suggested_lead_time(self) -> float:
         """The effective matchmaking window to use when the caller did not pin a
@@ -99,7 +105,9 @@ class Matchmaking:
                 else 0.7 * self.fill_latency_ema + 0.3 * latency
             )
             self._lead_backoff = max(1.0, self._lead_backoff / 2.0)
-        else:
+        elif self._others_observed:
+            # only a CONTENDED failure (peers were around, window still expired)
+            # is evidence the lead time is too short
             self._lead_backoff = min(self._lead_backoff * 2.0, 16.0)
 
     @property
@@ -120,6 +128,7 @@ class Matchmaking:
             self.data_for_gather = data_for_gather
             self.assembled_group = None
             self._tried_leaders.clear()
+            self._others_observed = False
             now = get_dht_time()
             self.declared_expiration_time = max(
                 scheduled_time if scheduled_time is not None else now + self.min_matchmaking_time,
@@ -203,7 +212,10 @@ class Matchmaking:
         now = get_dht_time()
         best: Optional[Tuple[DHTExpiration, PeerID]] = None
         for peer_id, expiration in candidates:
-            if peer_id == self.peer_id or peer_id in self._tried_leaders:
+            if peer_id == self.peer_id:
+                continue
+            self._others_observed = True
+            if peer_id in self._tried_leaders:
                 continue
             if expiration <= now or expiration >= self.declared_expiration_time:
                 continue  # stale, or they should be joining us instead
@@ -293,6 +305,7 @@ class Matchmaking:
             yield reject
             return
         outbox: asyncio.Queue = asyncio.Queue()
+        self._others_observed = True
         self.current_followers[context.remote_id] = (request, outbox)
         try:
             yield averaging_pb2.MessageFromLeader(code=averaging_pb2.ACCEPTED)

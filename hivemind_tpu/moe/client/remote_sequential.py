@@ -298,8 +298,20 @@ class RemoteSequential:
             # pinning them would let the route silently move to a cache-less peer.
             # Consecutive blocks on the SAME peer form a span served by one RPC
             # (Petals-style span execution): per-token round-trips = #servers.
-            state = {"route": self._grouped_range(0, self.num_blocks), "chunks": [], "positions": 0}
+            route = self._grouped_range(0, self.num_blocks)
             with self._lock:
+                # a reset REUSES the prior state's lock (atomically, under the
+                # global lock): an in-flight step on the old state then finishes
+                # before this reset's server-side prefill runs, so a failed old
+                # step cannot fail over AFTER the reset and clobber the fresh
+                # server sessions with the stale history
+                prior = self._decode_routes.get(session_id)
+                state = {
+                    "route": route,
+                    "chunks": [],
+                    "positions": 0,
+                    "lock": prior["lock"] if prior is not None else threading.Lock(),
+                }
                 self._decode_routes[session_id] = state
                 while len(self._decode_routes) > self.max_decode_routes:
                     self._decode_routes.pop(next(iter(self._decode_routes)))  # oldest
@@ -311,47 +323,53 @@ class RemoteSequential:
                     f"decode session {session_id!r} has no pinned route here; "
                     f"start it with reset=True"
                 )
-        # history retention: a LIST of chunks (concatenated only at failover, so a
-        # long generation costs O(1) per step, not an O(context) recopy), capped by
-        # max_failover_history — past the cap, retention stops and a dead peer is
-        # a hard error again (restart with reset=True), bounding client memory
-        if reset:
-            if self.max_failover_history and x.shape[1] <= self.max_failover_history:
-                state["chunks"], state["positions"] = [x], x.shape[1]
-            else:  # retention disabled (cap 0) or the prompt alone exceeds the cap
-                state["chunks"], state["positions"] = None, 0
-        elif state["chunks"] is not None:
-            if state["positions"] + x.shape[1] <= self.max_failover_history:
-                state["chunks"].append(x)
-                state["positions"] += x.shape[1]
-            else:
-                state["chunks"] = None  # over the cap: failover disabled for this session
-        try:
-            out = x
-            for block, span in state["route"]:
-                out = block.decode_np(out, session_id, reset=reset, span=span)
-        except Exception as e:
-            if state["chunks"] is None:
-                raise  # history over the retention cap (or disabled): no failover
-            history = np.concatenate(state["chunks"], axis=1)
-            logger.warning(
-                f"decode session {session_id!r} lost a pinned peer ({e!r}); "
-                f"failing over: re-resolving the route and re-prefilling from "
-                f"{history.shape[1]} retained positions"
-            )
+        # the per-session lock serializes concurrent decode_steps on the SAME
+        # session (advisor r4: an unguarded concurrent step could fail over with a
+        # half-appended chunk list); different sessions still decode in parallel.
+        # KV positions are inherently ordered, so serializing is the only sound
+        # semantics for same-session concurrency anyway.
+        with state["lock"]:
+            # history retention: a LIST of chunks (concatenated only at failover, so a
+            # long generation costs O(1) per step, not an O(context) recopy), capped by
+            # max_failover_history — past the cap, retention stops and a dead peer is
+            # a hard error again (restart with reset=True), bounding client memory
+            if reset:
+                if self.max_failover_history and x.shape[1] <= self.max_failover_history:
+                    state["chunks"], state["positions"] = [x], x.shape[1]
+                else:  # retention disabled (cap 0) or the prompt alone exceeds the cap
+                    state["chunks"], state["positions"] = None, 0
+            elif state["chunks"] is not None:
+                if state["positions"] + x.shape[1] <= self.max_failover_history:
+                    state["chunks"].append(x)
+                    state["positions"] += x.shape[1]
+                else:
+                    state["chunks"] = None  # over the cap: failover disabled for this session
             try:
-                out = self._decode_failover(session_id, state, history)
-            except Exception:
-                # a FAILED failover leaves surviving servers' caches re-prefilled to
-                # an unknown point and this chunk already in the history: the
-                # session is unusable — forget it so a caller retry gets the
-                # explicit "start with reset=True" error instead of silent
-                # divergence
-                with self._lock:
-                    self._decode_routes.pop(session_id, None)
-                raise
-            if not reset:
-                out = out[:, -x.shape[1]:]  # the caller expects this step's positions only
+                out = x
+                for block, span in state["route"]:
+                    out = block.decode_np(out, session_id, reset=reset, span=span)
+            except Exception as e:
+                if state["chunks"] is None:
+                    raise  # history over the retention cap (or disabled): no failover
+                history = np.concatenate(state["chunks"], axis=1)
+                logger.warning(
+                    f"decode session {session_id!r} lost a pinned peer ({e!r}); "
+                    f"failing over: re-resolving the route and re-prefilling from "
+                    f"{history.shape[1]} retained positions"
+                )
+                try:
+                    out = self._decode_failover(session_id, state, history)
+                except Exception:
+                    # a FAILED failover leaves surviving servers' caches re-prefilled to
+                    # an unknown point and this chunk already in the history: the
+                    # session is unusable — forget it so a caller retry gets the
+                    # explicit "start with reset=True" error instead of silent
+                    # divergence
+                    with self._lock:
+                        self._decode_routes.pop(session_id, None)
+                    raise
+                if not reset:
+                    out = out[:, -x.shape[1]:]  # the caller expects this step's positions only
         return out
 
     def _decode_failover(self, session_id: str, state: dict, history) -> "np.ndarray":

@@ -45,7 +45,13 @@
 //             arriving before 'K' is held, so the upgrade cannot race.
 // After 'O' on a DIAL/ACCEPT pair the two sockets are spliced byte-for-byte.
 //
-// Usage: relay_daemon [port] [identity_file]
+// Usage: relay_daemon [port] [identity_file] [unix_socket_path]
+//
+// With a unix_socket_path, the daemon ALSO listens on a 0600 AF_UNIX socket —
+// the trust boundary for the local data-plane proxy hop: the 'K' upgrade ships
+// session AEAD keys, and a TCP loopback port offers no peer credential, so
+// multi-user hosts must hand keys over the unix socket (filesystem-permission
+// enforced), never the port.
 //   identity_file (optional): raw 32-byte Ed25519 private key, loaded if present,
 //   created (0600) otherwise — keeps the relay identity stable across restarts so
 //   client pins keep working.
@@ -64,6 +70,8 @@
 #include <sys/epoll.h>
 #include <sys/random.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
 #include <unistd.h>
 
 #include <chrono>
@@ -859,7 +867,7 @@ int main(int argc, char** argv) {
   if (!relay_crypto::available)
     fprintf(stderr, "relay: libcrypto unavailable, registrations are UNAUTHENTICATED\n");
   if (relay_crypto::channel_available) {
-    const char* identity_path = argc > 2 ? argv[2] : nullptr;
+    const char* identity_path = argc > 2 && argv[2][0] != '\0' ? argv[2] : nullptr;
     if (identity_path != nullptr) {
       // persistent identity so client pins survive daemon restarts
       FILE* f = fopen(identity_path, "rb");
@@ -903,6 +911,29 @@ int main(int argc, char** argv) {
   if (listen(listener, 128) < 0) { perror("listen"); return 1; }
   set_nonblock(listener);
 
+  // optional same-user-only AF_UNIX listener (see usage comment): the socket
+  // file is created 0600, so the kernel enforces that only this user's
+  // processes can reach the 'K' key-handoff path
+  int unix_listener = -1;
+  const char* unix_path = argc > 3 && argv[3][0] != '\0' ? argv[3] : nullptr;
+  if (unix_path != nullptr) {
+    unix_listener = socket(AF_UNIX, SOCK_STREAM, 0);
+    sockaddr_un uaddr{};
+    uaddr.sun_family = AF_UNIX;
+    if (strlen(unix_path) >= sizeof(uaddr.sun_path)) {
+      fprintf(stderr, "relay: unix socket path too long: %s\n", unix_path);
+      return 1;
+    }
+    strncpy(uaddr.sun_path, unix_path, sizeof(uaddr.sun_path) - 1);
+    unlink(unix_path);
+    mode_t old_umask = umask(0177);
+    int rc = bind(unix_listener, (sockaddr*)&uaddr, sizeof(uaddr));
+    umask(old_umask);
+    if (rc < 0) { perror("unix bind"); return 1; }
+    if (listen(unix_listener, 128) < 0) { perror("unix listen"); return 1; }
+    set_nonblock(unix_listener);
+  }
+
   socklen_t alen = sizeof(addr);
   getsockname(listener, (sockaddr*)&addr, &alen);
   printf("relay listening on port %d\n", ntohs(addr.sin_port));
@@ -922,6 +953,12 @@ int main(int argc, char** argv) {
   ev.events = EPOLLIN;
   ev.data.fd = listener;
   epoll_ctl(g_epoll, EPOLL_CTL_ADD, listener, &ev);
+  if (unix_listener >= 0) {
+    epoll_event uev{};
+    uev.events = EPOLLIN;
+    uev.data.fd = unix_listener;
+    epoll_ctl(g_epoll, EPOLL_CTL_ADD, unix_listener, &uev);
+  }
 
   std::vector<epoll_event> events(256);
   double last_sweep = now_ms();
@@ -929,11 +966,12 @@ int main(int argc, char** argv) {
     int n = epoll_wait(g_epoll, events.data(), (int)events.size(), 1000);
     for (int i = 0; i < n; i++) {
       int fd = events[i].data.fd;
-      if (fd == listener) {
+      if (fd == listener || (unix_listener >= 0 && fd == unix_listener)) {
         while (true) {
-          int client = accept(listener, nullptr, nullptr);
+          int client = accept(fd, nullptr, nullptr);
           if (client < 0) break;
           set_nonblock(client);
+          // harmless no-op (ENOTSUP) on AF_UNIX clients
           setsockopt(client, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
           Conn* c = new Conn();
           c->fd = client;

@@ -53,6 +53,7 @@ family), and no aux/client modes (a slice is by definition a full NODE peer).
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Any, List, Optional, Tuple
 
@@ -299,25 +300,43 @@ class SliceOptimizer(ChronicFailureTracking):
                 self._samples += batch_size
 
             # process 0 decides; everyone else adopts the decision (one small
-            # device broadcast per step — control flow must not diverge)
+            # device broadcast per step — control flow must not diverge). The
+            # decision vector carries an ERROR flag in slot 4: if process 0's
+            # networking raises (DHT shutdown, tracker store failure), it still
+            # broadcasts — with the flag set — so every process raises in
+            # lockstep instead of the followers parking forever in the
+            # collective (advisor r4 medium finding).
+            network_error: Optional[BaseException] = None
             if self.is_network_process:
-                assert self.tracker is not None
-                self.tracker.report_local_progress(self.local_epoch, self._samples)
-                self._maybe_schedule_gradient_averaging()
-                catch_up = self.local_epoch < self.tracker.global_epoch
-                ready = self.tracker.ready_to_update_epoch
-                decision = np.asarray(
-                    [
-                        1.0 if catch_up else 0.0,
-                        1.0 if ready else 0.0,
-                        float(self.tracker.global_epoch),
-                        float(self.tracker.global_progress.num_peers),
-                    ],
-                    np.float32,
-                )
+                try:
+                    assert self.tracker is not None
+                    self.tracker.report_local_progress(self.local_epoch, self._samples)
+                    self._maybe_schedule_gradient_averaging()
+                    catch_up = self.local_epoch < self.tracker.global_epoch
+                    ready = self.tracker.ready_to_update_epoch
+                    decision = np.asarray(
+                        [
+                            1.0 if catch_up else 0.0,
+                            1.0 if ready else 0.0,
+                            float(self.tracker.global_epoch),
+                            float(self.tracker.global_progress.num_peers),
+                            0.0,
+                        ],
+                        np.float32,
+                    )
+                except BaseException as e:
+                    network_error = e
+                    decision = np.asarray([0.0, 0.0, -1.0, -1.0, 1.0], np.float32)
             else:
-                decision = np.zeros(4, np.float32)
+                decision = np.zeros(5, np.float32)
             decision = _broadcast(decision)
+            if decision[4] >= 0.5:
+                if network_error is not None:
+                    raise network_error
+                raise RuntimeError(
+                    "the slice's network process failed during its decision phase; "
+                    "raising in lockstep (see process 0's traceback for the cause)"
+                )
             catch_up, ready = decision[0] >= 0.5, decision[1] >= 0.5
             global_epoch, num_peers = int(decision[2]), int(decision[3])
 
@@ -382,13 +401,22 @@ class SliceOptimizer(ChronicFailureTracking):
         if num_peers > 1:
             averaged_ok = False
             if self.is_network_process:
-                assert self.grad_averager is not None
-                with self.grad_averager.get_tensors() as tensors:
-                    for tensor, fresh in zip(tensors, scratch):
-                        np.copyto(tensor, fresh)
+                # claim the pre-scheduled control BEFORE the guarded work: if the
+                # staging below fails, the control must still be consumed (and
+                # cancelled in the except), not left live to block re-scheduling
+                # and strand its matched groupmates until the averaging timeout
                 control = None if self._scheduled_control_invalid() else self.scheduled_grads
                 self.scheduled_grads = None
+                # EVERYTHING process-0-side — staging, the swarm round, and the
+                # averaged-result readback — happens before the flag broadcast,
+                # inside one guard: any failure degrades to local gradients in
+                # lockstep; nothing can raise between collectives and strand the
+                # followers (advisor r4 medium finding)
                 try:
+                    assert self.grad_averager is not None
+                    with self.grad_averager.get_tensors() as tensors:
+                        for tensor, fresh in zip(tensors, scratch):
+                            np.copyto(tensor, fresh)
                     weight = float(max(self._samples, 1))
                     if isinstance(self.grad_averager, GradientAverager):
                         # one call covers scheduled and unscheduled (the host
@@ -415,18 +443,21 @@ class SliceOptimizer(ChronicFailureTracking):
                             scheduled_time=get_dht_time() + self._matchmaking_delay(),
                         )
                     averaged_ok = result is not None
+                    if averaged_ok:
+                        with self.grad_averager.get_tensors() as tensors:
+                            for mirror, tensor in zip(scratch, tensors):
+                                np.copyto(mirror, tensor)
                 except Exception as e:
+                    averaged_ok = False
+                    if control is not None and not control.done():
+                        with contextlib.suppress(Exception):
+                            control.cancel()
                     logger.warning(f"slice gradient averaging failed ({e!r}); applying local gradients")
 
             # phase C (collective): adopt the round outcome
             flag = _broadcast(np.asarray([1.0 if averaged_ok else 0.0], np.float32))
             averaged_ok = bool(flag[0] >= 0.5)
             if averaged_ok:
-                if self.is_network_process:
-                    assert self.grad_averager is not None
-                    with self.grad_averager.get_tensors() as tensors:
-                        for mirror, tensor in zip(scratch, tensors):
-                            np.copyto(mirror, tensor)
                 for i in range(len(scratch)):
                     scratch[i] = _broadcast(np.ascontiguousarray(scratch[i]))
 
@@ -469,10 +500,15 @@ class SliceOptimizer(ChronicFailureTracking):
         downloads serve fresh tensors. Returns the per-process host copies."""
         state_scratch = self.bridge.gather_to_host(self._state_leaves())
         if self.is_network_process:
-            assert self.state_averager is not None
-            with self.state_averager.get_tensors() as tensors:
-                for tensor, fresh in zip(tensors, state_scratch):
-                    np.copyto(tensor, fresh)
+            try:
+                assert self.state_averager is not None
+                with self.state_averager.get_tensors() as tensors:
+                    for tensor, fresh in zip(tensors, state_scratch):
+                        np.copyto(tensor, fresh)
+            except Exception as e:
+                # non-fatal: the download mirrors stay one epoch staler; raising
+                # here would strand the followers at the next collective
+                logger.warning(f"failed to refresh state mirrors: {e!r}")
         return state_scratch
 
     def _collective_state_phase(self, next_epoch: int, num_peers: int) -> None:
@@ -485,8 +521,10 @@ class SliceOptimizer(ChronicFailureTracking):
             return
         ok = False
         if self.is_network_process:
-            assert self.state_averager is not None
+            # round + averaged-result readback both precede the flag broadcast,
+            # under one guard (same hang-proofing as the gradient phase)
             try:
+                assert self.state_averager is not None
                 ok = (
                     self.state_averager.step(
                         timeout=self.averaging_timeout,
@@ -494,16 +532,16 @@ class SliceOptimizer(ChronicFailureTracking):
                     )
                     is not None
                 )
+                if ok:
+                    with self.state_averager.get_tensors() as tensors:
+                        for mirror, tensor in zip(state_scratch, tensors):
+                            np.copyto(mirror, tensor)
             except Exception as e:
+                ok = False
                 logger.warning(f"slice state averaging failed: {e!r}")
         flag = _broadcast(np.asarray([1.0 if ok else 0.0], np.float32))
         if not bool(flag[0] >= 0.5):
             return
-        if self.is_network_process:
-            assert self.state_averager is not None
-            with self.state_averager.get_tensors() as tensors:
-                for mirror, tensor in zip(state_scratch, tensors):
-                    np.copyto(mirror, tensor)
         for i in range(len(state_scratch)):
             state_scratch[i] = _broadcast(np.ascontiguousarray(state_scratch[i]))
         self._adopt_state_tensors(state_scratch)
@@ -679,12 +717,16 @@ class SliceOptimizer(ChronicFailureTracking):
             self._collective_epoch_update(num_peers)
 
     def load_state_from_peers(self, timeout: Optional[float] = None) -> bool:
-        """Explicit collective state download (every process must call this)."""
+        """Explicit collective state download (every process must call this).
+        Takes the step lock like every other public collective entry point — a
+        concurrent ``step`` in another thread must not interleave with the
+        catch-up and tear the param tree (advisor r4 finding)."""
         del timeout  # the network process uses self.load_state_timeout
-        epoch_target = self.local_epoch
-        if self.is_network_process and self.tracker is not None:
-            epoch_target = max(epoch_target, self.tracker.global_epoch)
-        return self._collective_catch_up(epoch_target)
+        with self._step_lock:
+            epoch_target = self.local_epoch
+            if self.is_network_process and self.tracker is not None:
+                epoch_target = max(epoch_target, self.tracker.global_epoch)
+            return self._collective_catch_up(epoch_target)
 
     def shutdown(self) -> None:
         if self.tracker is not None:

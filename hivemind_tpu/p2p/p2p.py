@@ -114,6 +114,7 @@ class P2P:
         relays: Sequence[str] = (),
         max_connections: int = 0,
         data_proxy_port: Optional[int] = None,
+        data_proxy_path: Optional[str] = None,
     ) -> "P2P":
         """``relays``: relay daemons to register at on startup (reference parity:
         p2p_daemon.py use_relay/use_auto_relay). Each spec is ``host:port`` or
@@ -145,10 +146,20 @@ class P2P:
         # native data-plane proxy ('X' mode of the relay daemon): outbound dials
         # route through a LOCAL daemon that terminates the channel AEAD in C++
         # (reference role parity: the whole transport lives in the Go daemon,
-        # p2p_daemon.py:84-147). None/0 disables; env var is the zero-code path.
+        # p2p_daemon.py:84-147). None/0 disables; env vars are the zero-code path.
+        # TRUST BOUNDARY: the 'K' upgrade hands session AEAD keys to the daemon.
+        # ``data_proxy_path`` (an AF_UNIX socket the daemon creates 0600) confines
+        # that hop to this user via filesystem permissions — the reference's unix-
+        # domain-socket boundary (p2p_daemon.py daemon listen addr). The TCP
+        # loopback ``data_proxy_port`` carries no peer credential: any local
+        # process could bind or connect, so it must NOT be used on multi-user
+        # hosts (advisor r4). When both are set, the unix socket wins.
+        if data_proxy_path is None:
+            data_proxy_path = os.environ.get("HIVEMIND_TPU_DATA_PROXY_PATH") or None
         if data_proxy_port is None:
             env_port = os.environ.get("HIVEMIND_TPU_DATA_PROXY_PORT")
             data_proxy_port = int(env_port) if env_port else None
+        self._data_proxy_path = data_proxy_path or None
         self._data_proxy_port = data_proxy_port or None
         self._bg_tasks: Set[asyncio.Task] = set()  # strong refs: loop holds tasks weakly
         self._alive_refs = 1  # P2P.replicate parity: shared instance refcount
@@ -369,7 +380,7 @@ class P2P:
         """Dial one address. With ``replace_existing`` a live connection to the same
         peer is superseded for FUTURE streams (hole-punch upgrade: the direct path
         replaces the relayed one; in-flight streams finish on the old connection)."""
-        via_proxy = self._data_proxy_port is not None
+        via_proxy = self._data_proxy_port is not None or self._data_proxy_path is not None
         if via_proxy:
             try:
                 reader, writer = await asyncio.wait_for(
@@ -441,7 +452,11 @@ class P2P:
             if not infos:
                 raise ConnectionError(f"no IPv4 address for {host!r} (data-plane proxy is IPv4-only)")
             host = infos[0][4][0]
-        reader, writer = await asyncio.open_connection("127.0.0.1", self._data_proxy_port)
+        if self._data_proxy_path is not None:
+            # the 0600 unix socket is the key-handoff trust boundary (see create)
+            reader, writer = await asyncio.open_unix_connection(self._data_proxy_path)
+        else:
+            reader, writer = await asyncio.open_connection("127.0.0.1", self._data_proxy_port)
         request = b"X" + struct.pack(">H", port) + host.encode()
         writer.write(struct.pack(">I", len(request)) + request)
         await writer.drain()

@@ -256,8 +256,12 @@ def test_adaptive_matchmaking_lead_time_math():
     mm.min_matchmaking_time = 1.0
     mm.fill_latency_ema = None
     mm._lead_backoff = 1.0
+    mm._others_observed = False
 
     assert mm.suggested_lead_time() == 1.0
+    mm._record_round_outcome(None)  # solo swarm: nobody to match with, no backoff
+    assert mm.suggested_lead_time() == 1.0  # advisor r4: solo expiry must not ratchet
+    mm._others_observed = True  # peers are around now: expiry means contention
     mm._record_round_outcome(None)  # window expired
     mm._record_round_outcome(None)
     assert mm.suggested_lead_time() == 4.0  # 1.0 * 2 * 2

@@ -31,6 +31,23 @@ def relay_process():
     proc.wait()
 
 
+@pytest.fixture(scope="module")
+def relay_process_unix(tmp_path_factory):
+    """A daemon ALSO listening on a 0600 AF_UNIX socket — the multi-user-safe
+    trust boundary for the data-plane proxy's 'K' key handoff (advisor r4)."""
+    if not RELAY_BIN.exists():
+        subprocess.run(["make"], cwd=NATIVE_DIR, check=True, capture_output=True)
+    socket_path = str(tmp_path_factory.mktemp("proxy") / "proxy.sock")
+    proc = subprocess.Popen(
+        [str(RELAY_BIN), "0", "", socket_path], stdout=subprocess.PIPE, text=True
+    )
+    line = proc.stdout.readline()
+    assert "listening" in line, line
+    yield socket_path
+    proc.kill()
+    proc.wait()
+
+
 async def test_relayed_rpc_end_to_end(relay_process):
     port = relay_process
     # "firewalled" peer: registers at the relay, never shares its direct address
@@ -412,6 +429,31 @@ async def test_data_plane_proxy_dial(relay_process):
         ):
             pass
         assert received and received[0] >= 6_000_000
+    finally:
+        await client.shutdown()
+        await server.shutdown()
+
+
+async def test_data_plane_proxy_over_unix_socket(relay_process_unix):
+    """The proxy hop over the daemon's AF_UNIX listener: the socket file is 0600
+    (kernel-enforced same-user trust boundary for the 'K' key handoff — the
+    reference confines its daemon hop to a unix socket the same way,
+    p2p_daemon.py:84-147), and dials through it carry RPCs end to end."""
+    socket_path = relay_process_unix
+    assert (os.stat(socket_path).st_mode & 0o777) == 0o600, oct(os.stat(socket_path).st_mode)
+
+    server = await P2P.create()
+    client = await P2P.create(data_proxy_path=socket_path)
+    try:
+        async def echo(request: test_pb2.TestRequest, context: P2PContext) -> test_pb2.TestResponse:
+            return test_pb2.TestResponse(number=request.number + 1)
+
+        await server.add_protobuf_handler("echo", echo, test_pb2.TestRequest)
+        await client.connect(server.get_visible_maddrs()[0])
+        response = await client.call_protobuf_handler(
+            server.peer_id, "echo", test_pb2.TestRequest(number=41), test_pb2.TestResponse
+        )
+        assert response.number == 42
     finally:
         await client.shutdown()
         await server.shutdown()

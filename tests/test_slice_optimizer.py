@@ -379,6 +379,43 @@ def test_slice_optimizer_with_powersgd_interoperates_with_host_peer():
         host_dht.shutdown()
 
 
+def test_network_process_failure_raises_in_lockstep_not_hangs():
+    """Advisor r4 medium finding: if process 0's networking raises inside step()'s
+    decision phase (DHT store failure, tracker shutdown), it must STILL broadcast
+    — with the error flag set — so followers raise in lockstep instead of parking
+    forever in the collective. On one process we can assert the p0 half: the
+    original exception propagates (after the sentinel broadcast) rather than
+    being swallowed or skipping the broadcast."""
+    import jax
+    import numpy as np
+    import optax
+    import pytest
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from hivemind_tpu.dht import DHT
+    from hivemind_tpu.optim import SliceOptimizer
+
+    mesh = Mesh(np.array(jax.devices()).reshape(len(jax.devices())), ("dp",))
+    sharding = NamedSharding(mesh, P("dp"))
+    opt = SliceOptimizer(
+        mesh=mesh, params={"w": jax.device_put(np.ones((8, 4), np.float32), sharding)},
+        optimizer=optax.sgd(0.1), dht_factory=lambda: DHT(start=True),
+        run_id="sentinel_bcast", target_batch_size=64, batch_size_per_step=4,
+    )
+    try:
+        g = {"w": jax.device_put(np.ones((8, 4), np.float32), sharding)}
+        opt.step(g, batch_size=4)  # sanity: a healthy step works
+
+        def boom(*args, **kwargs):
+            raise OSError("injected: dht store failed")
+
+        opt.tracker.report_local_progress = boom
+        with pytest.raises(OSError, match="injected: dht store failed"):
+            opt.step(g, batch_size=4)
+    finally:
+        opt.shutdown()
+
+
 def test_slice_chronic_failure_counter_and_backoff():
     """Host-Optimizer parity (optimizer.py:100-136): consecutive failed swarm
     rounds escalate to chronic failure, matchmaking lead time backs off
