@@ -27,6 +27,13 @@ def main():
     parser.add_argument("--seq_len", type=int, default=32)
     parser.add_argument("--learning_rate", type=float, default=2e-3)
     parser.add_argument("--metrics_jsonl", default=None)
+    parser.add_argument("--delay_grad_averaging", action="store_true",
+                        help="overlap the swarm round with training (slice DPU)")
+    parser.add_argument("--inject_round_latency", type=float, default=0.0,
+                        help="seconds of artificial latency added to every slice "
+                             "swarm round (models a slow-sending groupmate); the "
+                             "A/B vs --delay_grad_averaging shows epochs/min "
+                             "staying flat as this grows")
     from hivemind_tpu.utils.platform import add_platform_arg, apply_platform
 
     add_platform_arg(parser)
@@ -79,12 +86,27 @@ def main():
 
     boot = DHT(start=True)
     maddrs = [str(m) for m in boot.get_visible_maddrs()]
+    matchmaking_time = max(1.5, args.inject_round_latency + 1.5)
     slice_opt = SliceOptimizer(
         mesh=mesh, params=params, optimizer=optimizer, dht_factory=lambda: boot,
         run_id="slice_collab_bench", target_batch_size=args.target_batch_size,
         batch_size_per_step=args.batch_size, target_group_size=2,
-        matchmaking_time=1.5, averaging_timeout=40.0,
+        matchmaking_time=matchmaking_time, averaging_timeout=60.0,
+        delay_grad_averaging=args.delay_grad_averaging,
     )
+    if args.inject_round_latency > 0:
+        # every slice round pays the injected latency inside the (blocking or
+        # background) averager call; pre-scheduling is disabled so no round can
+        # bypass the injection through an already-matched control
+        slice_opt._maybe_schedule_gradient_averaging = lambda: None
+        real_step = slice_opt.grad_averager.step
+
+        def slow_step(*step_args, **step_kwargs):
+            if step_kwargs.get("wait", True):
+                time.sleep(args.inject_round_latency)
+            return real_step(*step_args, **step_kwargs)
+
+        slice_opt.grad_averager.step = slow_step
 
     # ---- host peer: same model replicated on one "chip" (plain arrays)
     host_config = AlbertConfig.tiny(num_heads=4)
@@ -97,7 +119,7 @@ def main():
         dht=host_dht, run_id="slice_collab_bench", params=host_params,
         optimizer=optimizer, target_batch_size=args.target_batch_size,
         batch_size_per_step=args.batch_size, target_group_size=2,
-        matchmaking_time=1.5, averaging_timeout=40.0,
+        matchmaking_time=matchmaking_time, averaging_timeout=60.0,
     )
 
     stop = threading.Event()
@@ -137,6 +159,11 @@ def main():
                 sink.write(json.dumps(record) + "\n")
             step_index += 1
             time.sleep(0.05)
+        # drain a still-pending delayed round so its update lands before shutdown
+        drain_deadline = time.perf_counter() + 120
+        while getattr(slice_opt, "_pending", None) is not None and time.perf_counter() < drain_deadline:
+            slice_opt.step(None)
+            time.sleep(0.1)
         elapsed = time.perf_counter() - start
     finally:
         stop.set()
@@ -165,8 +192,13 @@ def main():
             "slice_loss_ema_start": round(loss_start, 4),
             "slice_loss_ema_end": round(loss_end, 4),
             "steps": step_index,
+            # actual training compute delivered by the slice: the DPU A/B's
+            # headline (a stalled mesh shows up here, not in epochs/min)
+            "steps_per_min": round(step_index / (elapsed / 60.0), 1),
             "seconds": round(elapsed, 1),
             "target_batch_size": args.target_batch_size,
+            "delay_grad_averaging": args.delay_grad_averaging,
+            "inject_round_latency": args.inject_round_latency,
         },
     }))
 

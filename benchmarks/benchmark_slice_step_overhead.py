@@ -1,0 +1,102 @@
+"""Per-step overhead of the SliceOptimizer decision broadcast, and what the
+skip-count thinning buys (VERDICT r4 next-round #8).
+
+Measures µs/step of `SliceOptimizer.step` on the virtual mesh with a trivial
+gradient tree, far from any epoch boundary (the steady-state hot path), for
+``max_broadcast_skip`` 0 vs N. On a single process the device broadcast itself
+is cheap — the point is the CONTROL-PATH cost (tracker report + decision build +
+collective dispatch) that thinning removes; on a real multi-host mesh the
+skipped broadcast also removes a host round-trip per step.
+
+Prints one JSON line."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
+
+import argparse
+import json
+import time
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num_devices", type=int, default=8)
+    parser.add_argument("--steps", type=int, default=400)
+    parser.add_argument("--max_broadcast_skip", type=int, default=8)
+    from hivemind_tpu.utils.platform import add_platform_arg, apply_platform
+
+    add_platform_arg(parser)
+    args = parser.parse_args()
+    if args.platform is None:
+        args.platform = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if args.platform == "cpu" and "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={args.num_devices}"
+        ).strip()
+    apply_platform(args)
+
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from hivemind_tpu.dht import DHT
+    from hivemind_tpu.optim import SliceOptimizer
+
+    mesh = Mesh(np.array(jax.devices()).reshape(len(jax.devices())), ("dp",))
+    sharding = NamedSharding(mesh, P("dp"))
+
+    def measure(max_skip: int) -> dict:
+        opt = SliceOptimizer(
+            mesh=mesh,
+            params={"w": jax.device_put(np.zeros((8, 128), np.float32), sharding)},
+            optimizer=optax.sgd(0.1), dht_factory=lambda: DHT(start=True),
+            run_id=f"step_overhead_{max_skip}",
+            # huge target: the loop below never reaches a boundary — pure hot path
+            target_batch_size=1 << 30, batch_size_per_step=1,
+            max_broadcast_skip=max_skip,
+        )
+        g = {"w": jax.device_put(np.ones((8, 128), np.float32), sharding)}
+        try:
+            for _ in range(20):  # warm the jits + the step-time EMA
+                opt.step(g, batch_size=1)
+            # measure the CONTROL PATH alone (grads=None skips the jitted
+            # accumulate, whose ~1 ms dispatch would swamp the decision cost)
+            start = time.perf_counter()
+            skipped = 0
+            for _ in range(args.steps):
+                if opt._skip_remaining > 0:
+                    skipped += 1
+                opt.step(None)
+            elapsed = time.perf_counter() - start
+            return {
+                "us_per_step": round(elapsed / args.steps * 1e6, 1),
+                "skipped_fraction": round(skipped / args.steps, 3),
+            }
+        finally:
+            opt.shutdown()
+
+    with_broadcast = measure(0)
+    thinned = measure(args.max_broadcast_skip)
+    print(json.dumps({
+        "metric": "slice_step_decision_overhead_us",
+        "value": with_broadcast["us_per_step"],
+        "unit": "us/step (broadcast every step)",
+        "extra": {
+            "thinned_us_per_step": thinned["us_per_step"],
+            "thinned_skipped_fraction": thinned["skipped_fraction"],
+            "max_broadcast_skip": args.max_broadcast_skip,
+            "num_devices": args.num_devices,
+            "steps": args.steps,
+            "note": "single-process mesh: measures the control path; a real "
+                    "multi-host mesh additionally saves one host round-trip "
+                    "per skipped step",
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

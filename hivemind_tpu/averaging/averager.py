@@ -534,6 +534,7 @@ class DecentralizedAverager(ServicerBase):
         *,
         exclude_peer_id: Optional[PeerID] = None,
         timeout: Optional[float] = None,
+        expected_tensors: Optional[int] = None,
     ) -> Optional[Tuple[Any, List[np.ndarray]]]:
         """Fetch (metadata, tensors) from the best-priority peer declared under
         ``{prefix}.all_averagers``. Classmethod on purpose: peers that do not yet
@@ -568,6 +569,15 @@ class DecentralizedAverager(ServicerBase):
                 from hivemind_tpu.compression import deserialize_tensor_stream
 
                 tensors = await deserialize_tensor_stream(_tensor_parts())
+                if expected_tensors is not None and len(tensors) != expected_tensors:
+                    # a donor that died mid-download can end its stream CLEANLY
+                    # after a few chunks; a truncated schema must fail over to
+                    # the next candidate, not be returned as "the state"
+                    logger.warning(
+                        f"state download from {peer_id} was truncated "
+                        f"({len(tensors)}/{expected_tensors} tensors); trying the next donor"
+                    )
+                    continue
                 if "metadata" in holder or tensors:
                     logger.info(f"downloaded state from {peer_id}")
                     return holder.get("metadata"), tensors
@@ -577,8 +587,13 @@ class DecentralizedAverager(ServicerBase):
         return None
 
     async def _load_state_from_peers_async(self, timeout: Optional[float] = None) -> Optional[Tuple[Any, List[np.ndarray]]]:
+        # an averager KNOWS its schema: donors serving a different tensor count
+        # (truncated mid-download or mismatched run) are skipped in-loop
+        with self.get_tensors() as tensors:
+            expected = len(tensors)
         return await type(self)._download_state_async(
-            self.dht, self.p2p, self.prefix, exclude_peer_id=self.peer_id, timeout=timeout
+            self.dht, self.p2p, self.prefix, exclude_peer_id=self.peer_id, timeout=timeout,
+            expected_tensors=expected,
         )
 
     def load_state_from_peers(self, timeout: Optional[float] = None, wait: bool = True):

@@ -9,6 +9,7 @@ from hivemind_tpu.moe.client import (
 from hivemind_tpu.moe.expert_uid import ExpertInfo, ExpertUID, is_valid_prefix, is_valid_uid, split_uid
 from hivemind_tpu.moe.server import (
     ConnectionHandler,
+    MeshModuleBackend,
     ModuleBackend,
     Runtime,
     Server,

@@ -2,6 +2,7 @@ from hivemind_tpu.moe.server.checkpoints import CheckpointSaver, load_experts, s
 from hivemind_tpu.moe.server.connection_handler import ConnectionHandler
 from hivemind_tpu.moe.server.dht_handler import declare_experts, get_experts
 from hivemind_tpu.moe.server.layers import register_expert_class
+from hivemind_tpu.moe.server.mesh_backend import MeshModuleBackend
 from hivemind_tpu.moe.server.module_backend import ModuleBackend
 from hivemind_tpu.moe.server.runtime import Runtime
 from hivemind_tpu.moe.server.server import Server, background_server

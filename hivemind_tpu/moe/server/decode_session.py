@@ -152,6 +152,11 @@ class DecodeSessionManager:
             session = self._sessions.get(key)
             if reset:
                 cache_k, cache_v = backend.module.init_decode_cache(batch, self.max_len)
+                if hasattr(backend, "shard_decode_cache"):
+                    # mesh-sharded serving: the session's KV lives distributed
+                    # over the backend's mesh (MeshModuleBackend), so a cache
+                    # that exceeds one chip's HBM still fits the slice
+                    cache_k, cache_v = backend.shard_decode_cache(cache_k, cache_v)
                 session = self._sessions[key] = _Session(cache_k, cache_v)
             elif session is None:
                 # NEVER silently prefill a continuation: an evicted/expired/unknown

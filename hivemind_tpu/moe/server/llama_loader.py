@@ -126,16 +126,21 @@ def load_llama_blocks(
     weight_quantization: Optional[str] = None,
     max_batch_size: int = 64,
     optimizer=None,
+    mesh=None,
+    shard_axis: str = "tp",
 ) -> Tuple[Dict[str, "object"], LlamaCheckpointConfig]:
     """Build ``{uid: ModuleBackend}`` serving the checkpoint's decoder layers.
 
     ``layers`` defaults to all of them; uid = ``f"{uid_prefix}{layer}"`` so a
     ``RemoteSequential(dht, uid_prefix, n)`` client chains them in order. Blocks
-    are loaded one at a time (host memory ~= one block).
+    are loaded one at a time (host memory ~= one block). With ``mesh``, each
+    block becomes a :class:`MeshModuleBackend` — params and KV caches sharded
+    over ``shard_axis``, for blocks one chip cannot hold.
     """
     import optax
 
     from hivemind_tpu.moe.server.layers import name_to_block
+    from hivemind_tpu.moe.server.mesh_backend import MeshModuleBackend
     from hivemind_tpu.moe.server.module_backend import ModuleBackend
 
     config = LlamaCheckpointConfig.load(checkpoint_dir)
@@ -152,14 +157,18 @@ def load_llama_blocks(
             ffn_inner=config.intermediate_size,
             rms_eps=config.rms_norm_eps,
         )
-        backend = ModuleBackend(
-            f"{uid_prefix}{layer}",
-            module,
+        common_opts = dict(
             optimizer=optimizer or optax.sgd(0.0),
             sample_input=np.zeros((2, 8, config.hidden_size), np.float32),
             max_batch_size=max_batch_size,
             weight_quantization=weight_quantization,
         )
+        if mesh is not None:
+            backend = MeshModuleBackend(
+                f"{uid_prefix}{layer}", module, mesh=mesh, shard_axis=shard_axis, **common_opts
+            )
+        else:
+            backend = ModuleBackend(f"{uid_prefix}{layer}", module, **common_opts)
         backend.load_params(_block_params_from_hf(reader, layer))
         backends[backend.name] = backend
         logger.info(
@@ -236,8 +245,17 @@ def plan_block_capacity(
     decode_sessions: int = 0,
     cache_bytes_per_session_block: int = 0,
     reserve_fraction: float = 0.2,
+    mesh_devices: int = 1,
 ) -> int:
-    """How many blocks fit one chip: ``(HBM*(1-reserve) - sessions*cache) / block``.
+    """How many blocks fit the serving unit:
+    ``(HBM*devices*(1-reserve) - sessions*cache) / block``.
+
+    ``mesh_devices`` > 1 plans a MESH-sharded server (``MeshModuleBackend``):
+    ``hbm_bytes`` stays the PER-CHIP budget and the pooled budget scales with the
+    mesh — the regime where one chip cannot hold a single block but the slice
+    can. Sharded residency is what makes the pooling real: params and KV caches
+    divide across the mesh axis, so per-chip residency is ``1/mesh_devices`` of
+    each block (see MeshModuleBackend.param_bytes_per_device).
 
     ``reserve_fraction`` keeps headroom for activations, the transient dense
     weights of int8 serving, and XLA workspace. Returns at least 0.
@@ -248,7 +266,7 @@ def plan_block_capacity(
         raise ValueError(
             "platform does not report a memory limit; pass hbm_bytes explicitly"
         )
-    usable = int(hbm_bytes * (1.0 - reserve_fraction))
+    usable = int(hbm_bytes * max(int(mesh_devices), 1) * (1.0 - reserve_fraction))
     per_block = block_bytes + decode_sessions * cache_bytes_per_session_block
     if per_block <= 0:
         return 0

@@ -68,8 +68,7 @@ class ModuleBackend:
         self.max_batch_size = max_batch_size
         self.weight_quantization = weight_quantization
         samples = tuple(jnp.asarray(np.asarray(s)[:1]) for s in sample_inputs)
-        self.params = module.init(jax.random.PRNGKey(rng_seed), *samples)["params"]
-        self.opt_state = optimizer.init(self.params) if weight_quantization is None else None
+        self.params, self.opt_state = self._init_state(samples, rng_seed)
         self._state_lock = threading.Lock()
         self.update_count = 0
 
@@ -117,6 +116,13 @@ class ModuleBackend:
             pad_width = [(0, bucket - n)] + [(0, 0)] * (batch.ndim - 1)
             batch = np.pad(batch, pad_width)
         return jnp.asarray(batch), n
+
+    def _init_state(self, samples, rng_seed: int):
+        """Create (params, opt_state); subclasses control placement (the mesh
+        backend lands state directly under its shardings)."""
+        params = self.module.init(jax.random.PRNGKey(rng_seed), *samples)["params"]
+        opt_state = self.optimizer.init(params) if self.weight_quantization is None else None
+        return params, opt_state
 
     def snapshot_params(self):
         """The current parameter pytree under the state lock (for read-only use by

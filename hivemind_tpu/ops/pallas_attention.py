@@ -37,6 +37,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 BLOCK_Q = 128
 BLOCK_K = 128
+# Row statistics (max / sum / lse / delta) are carried with a 128-wide minor dim:
+# Mosaic requires the last two dims of every block to tile onto (8, 128) lanes,
+# so a [BLOCK_Q] column vector is broadcast across _LANES and read back from
+# lane 0 (the official TPU flash kernel stores l/m the same way,
+# jax/experimental/pallas/ops/tpu/flash_attention.py MIN_BLOCK_SIZE).
+_LANES = 128
 _NEG_INF = -1e30  # large-but-finite: keeps fully-masked rows NaN-free
 
 
@@ -84,8 +90,9 @@ def _flash_kernel(
         acc_ref[:] = acc_ref[:] * correction[:, None] + jax.lax.dot_general(
             probs, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        sum_ref[:, 0] = sum_ref[:, 0] * correction + jnp.sum(probs, axis=-1)
-        max_ref[:, 0] = new_max
+        new_sum = sum_ref[:, 0] * correction + jnp.sum(probs, axis=-1)
+        sum_ref[:] = jnp.broadcast_to(new_sum[:, None], sum_ref.shape)
+        max_ref[:] = jnp.broadcast_to(new_max[:, None], max_ref.shape)
 
     @pl.when(kv_index == num_kv - 1)
     def _finalize():
@@ -93,7 +100,8 @@ def _flash_kernel(
         out_ref[0] = out.astype(out_ref.dtype)
         # log-sum-exp per query row: what ring attention needs to merge softmax
         # statistics across sequence shards without re-materializing the scores
-        lse_ref[0] = max_ref[:, 0] + jnp.log(jnp.maximum(sum_ref[:, 0], 1e-30))
+        lse = max_ref[:, 0] + jnp.log(jnp.maximum(sum_ref[:, 0], 1e-30))
+        lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
 @partial(jax.jit, static_argnames=("causal", "interpret"))
@@ -118,21 +126,21 @@ def _flash_forward(q, k, v, causal: bool = False, interpret: bool = False):
         ],
         out_specs=[
             pl.BlockSpec((1, BLOCK_Q, head_dim), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, BLOCK_Q), lambda bh, qi, ki: (bh, qi)),
+            pl.BlockSpec((1, BLOCK_Q, _LANES), lambda bh, qi, ki: (bh, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((batch * heads, qb.shape[1], head_dim), q.dtype),
-            jax.ShapeDtypeStruct((batch * heads, qb.shape[1]), jnp.float32),
+            jax.ShapeDtypeStruct((batch * heads, qb.shape[1], _LANES), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((BLOCK_Q, 1), jnp.float32),  # running row max
-            pltpu.VMEM((BLOCK_Q, 1), jnp.float32),  # running row sum
+            pltpu.VMEM((BLOCK_Q, _LANES), jnp.float32),  # running row max
+            pltpu.VMEM((BLOCK_Q, _LANES), jnp.float32),  # running row sum
             pltpu.VMEM((BLOCK_Q, head_dim), jnp.float32),  # output accumulator
         ],
         interpret=interpret,
     )(qb, kb, vb)
     out = out[:, :seq].reshape(batch, heads, seq, head_dim)
-    lse = lse[:, :seq].reshape(batch, heads, seq)
+    lse = lse[:, :seq, 0].reshape(batch, heads, seq)
     return jnp.transpose(out, (0, 2, 1, 3)), lse
 
 
@@ -152,8 +160,8 @@ def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *, kv_start, q_st
     k = k_ref[0].astype(jnp.float32)  # [BLOCK_K, d]
     v = v_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]  # [BLOCK_Q] fp32
-    delta = delta_ref[0]  # [BLOCK_Q] fp32, rowsum(dout * out)
+    lse = lse_ref[0][:, 0]  # [BLOCK_Q] fp32 (lane 0 of the 128-wide carry)
+    delta = delta_ref[0][:, 0]  # [BLOCK_Q] fp32, rowsum(dout * out)
     scale = q.shape[-1] ** -0.5
     scores = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -260,11 +268,14 @@ def _flash_backward(q, k, v, out, lse, grad_out, causal: bool = False, interpret
     pad = padded_q - seq
     if pad:
         lseb = jnp.pad(lseb, ((0, 0), (0, pad)))
+    # 128-lane broadcast of the row statistics (see _LANES)
+    lseb = jnp.broadcast_to(lseb[:, :, None], (*lseb.shape, _LANES))
+    deltab = jnp.broadcast_to(deltab[:, :, None], (*deltab.shape, _LANES))
 
     num_q, num_kv = padded_q // BLOCK_Q, kb.shape[1] // BLOCK_K
     q_spec = pl.BlockSpec((1, BLOCK_Q, head_dim), lambda bh, qi, ki: (bh, qi, 0))
     kv_spec = pl.BlockSpec((1, BLOCK_K, head_dim), lambda bh, qi, ki: (bh, ki, 0))
-    row_spec = pl.BlockSpec((1, BLOCK_Q), lambda bh, qi, ki: (bh, qi))
+    row_spec = pl.BlockSpec((1, BLOCK_Q, _LANES), lambda bh, qi, ki: (bh, qi, 0))
     dq = pl.pallas_call(
         partial(_flash_bwd_dq_kernel, seq_len=seq, causal=causal),
         grid=(batch * heads, num_q, num_kv),
@@ -277,7 +288,7 @@ def _flash_backward(q, k, v, out, lse, grad_out, causal: bool = False, interpret
     # second pass: grid transposed — (bh, kv block, q block), q fastest-varying
     q_spec_t = pl.BlockSpec((1, BLOCK_Q, head_dim), lambda bh, ki, qi: (bh, qi, 0))
     kv_spec_t = pl.BlockSpec((1, BLOCK_K, head_dim), lambda bh, ki, qi: (bh, ki, 0))
-    row_spec_t = pl.BlockSpec((1, BLOCK_Q), lambda bh, ki, qi: (bh, qi))
+    row_spec_t = pl.BlockSpec((1, BLOCK_Q, _LANES), lambda bh, ki, qi: (bh, qi, 0))
     dk, dv = pl.pallas_call(
         partial(_flash_bwd_dkv_kernel, seq_len=seq, causal=causal),
         grid=(batch * heads, num_kv, num_q),
@@ -331,6 +342,15 @@ def _flash_enabled() -> bool:
     return os.environ.get("HIVEMIND_TPU_FLASH_ATTENTION", "1") == "1"
 
 
+def _flash_forced() -> bool:
+    """HIVEMIND_TPU_FORCE_FLASH=1 selects the flash kernels regardless of the
+    CURRENT backend — for AOT workflows (jax.export platforms=["tpu"]) where the
+    trace happens on a CPU host but the artifact targets a TPU."""
+    import os
+
+    return os.environ.get("HIVEMIND_TPU_FORCE_FLASH", "0") == "1"
+
+
 def attention_auto(q, k, v, mask=None, causal: bool = False):
     """Backend dispatch for the attention core: fused Pallas kernel on TPU (full
     sequences; both directions are fused kernels — set
@@ -341,7 +361,7 @@ def attention_auto(q, k, v, mask=None, causal: bool = False):
     if (
         mask is None
         and q.shape[1] == k.shape[1]
-        and jax.default_backend() == "tpu"
+        and (jax.default_backend() == "tpu" or _flash_forced())
         and _flash_enabled()
     ):
         return flash_attention(q, k, v, causal)

@@ -311,7 +311,9 @@ class Optimizer(ChronicFailureTracking):
         """Average gradients with the swarm, apply one optax update, advance the epoch
         (reference _update_global_epoch, optimizer.py:438-509)."""
         assert self.grad_averager is not None and self.state_averager is not None
-        next_epoch = max(self.local_epoch, self.tracker.global_epoch) + 1
+        # a peer REJOINING after the swarm advanced lands ON the global epoch,
+        # not past it (reference optimizer.py:462)
+        next_epoch = max(self.local_epoch + 1, self.tracker.global_epoch)
 
         averaged_ok: Optional[bool] = None  # None = no round attempted (solo swarm)
         if self.tracker.global_progress.num_peers > 1:
@@ -382,7 +384,7 @@ class Optimizer(ChronicFailureTracking):
         self.grad_averager.reset_accumulated_grads_()
         control = None if self._scheduled_control_invalid() else self.scheduled_grads
         self.scheduled_grads = None
-        next_epoch = max(self.local_epoch, self.tracker.global_epoch) + 1
+        next_epoch = max(self.local_epoch + 1, self.tracker.global_epoch)
         self._pending_update = self._update_executor.submit(
             self._delayed_epoch_update, control, weight, next_epoch
         )
@@ -428,9 +430,12 @@ class Optimizer(ChronicFailureTracking):
         only a wider gap warrants downloading a peer's state."""
         if self._pending_update is not None and not self._pending_update.done():
             return False  # our own transition is mid-flight, not a straggler
-        if self.delay_optimizer_step:
-            return self.local_epoch < self.tracker.global_epoch - 1
-        return self.local_epoch < self.tracker.global_epoch
+        # one-epoch grace for EVERY mode (reference optimizer.py:654-672): the
+        # first peer to see enough samples transitions and restarts the count —
+        # a peer observing global == local + 1 is witnessing normal network
+        # asynchrony and must transition itself (the tracker reports it ready),
+        # not discard its progress and download state
+        return self.local_epoch < self.tracker.global_epoch - 1
 
     def _catch_up_with_swarm(self) -> None:
         """We are behind the swarm: adopt a peer's state

@@ -152,7 +152,14 @@ class ProgressTracker:
 
     @property
     def ready_to_update_epoch(self) -> bool:
-        return self.global_progress.ready_to_update_epoch
+        # a peer whose swarm already advanced transitions ITSELF right away
+        # (reference progress_tracker.py:128-134) — without this clause, peers
+        # that see a groupmate bump the epoch first would mistake the normal
+        # lack of network synchrony for having fallen behind
+        return (
+            self.global_progress.global_epoch > self.local_progress.epoch
+            or self.global_progress.ready_to_update_epoch
+        )
 
     def report_local_progress(self, local_epoch: int, samples_accumulated: int, update_ema: bool = True) -> None:
         """Update the local record and wake the reporter

@@ -157,12 +157,12 @@ def mesh_attention_core(mesh, q, k, v, mask=None, causal: bool = False):
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
-        from hivemind_tpu.ops.pallas_attention import _flash_enabled
+        from hivemind_tpu.ops.pallas_attention import _flash_enabled, _flash_forced
 
         assert mask is None, "ring attention shards carry full sequences (no padding mask)"
         spec = P("dp", "sp", "tp" if mesh.shape.get("tp", 1) > 1 else None, None)
         extra = {}
-        if _flash_enabled() and jax.default_backend() == "tpu":
+        if _flash_enabled() and (jax.default_backend() == "tpu" or _flash_forced()):
             # flash core per ring step: scores stay in VMEM, shard outputs merge
             # via log-sum-exp. check_vma off: the varying-axes checker cannot see
             # through pallas_call outputs.

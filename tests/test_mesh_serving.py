@@ -1,0 +1,137 @@
+"""Mesh-sharded block serving (VERDICT r4 next-round #4): a served block whose
+params + KV caches are NamedSharding global arrays over a device mesh, behind the
+UNCHANGED Server/RemoteSequential path — clients get token-identical generations
+whether one device or the whole mesh answers. Re-designed reference role: the
+single-CUDA-device executor of hivemind/moe/server/runtime.py:22-199."""
+
+import time
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec
+
+from hivemind_tpu.dht import DHT
+from hivemind_tpu.moe.server.llama_loader import (
+    LlamaCheckpointConfig,
+    decode_cache_bytes,
+    load_llama_blocks,
+    plan_block_capacity,
+    predict_block_param_bytes,
+)
+from hivemind_tpu.moe.server.mesh_backend import MeshModuleBackend
+from hivemind_tpu.moe.server.server import Server
+
+from test_llama_loader import HID, LAYERS, _write_checkpoint
+
+
+def _tp_mesh() -> Mesh:
+    devices = np.array(jax.devices())
+    return Mesh(devices.reshape(len(devices)), ("tp",))
+
+
+def test_mesh_backend_shards_params_and_caches(tmp_path):
+    _write_checkpoint(tmp_path)
+    mesh = _tp_mesh()
+    backends, _config = load_llama_blocks(tmp_path, uid_prefix="mb.", mesh=mesh)
+    backend = backends["mb.0"]
+    assert isinstance(backend, MeshModuleBackend)
+
+    # the big kernels really live distributed: each device holds 1/8th
+    sharded_leaves = [
+        leaf
+        for leaf in jax.tree_util.tree_leaves(backend.params)
+        if backend.leaf_spec(leaf) != PartitionSpec()
+    ]
+    assert sharded_leaves, "no parameter leaf was sharded"
+    for leaf in sharded_leaves:
+        shard = leaf.addressable_shards[0]
+        assert shard.data.size == leaf.size // len(mesh.devices.flat)
+    assert backend.param_bytes_per_device() < backend.param_bytes()
+
+    # KV decode caches shard through the session-manager hook
+    cache_k, cache_v = backend.module.init_decode_cache(2, 32)
+    sharded_k, sharded_v = backend.shard_decode_cache(cache_k, cache_v)
+    assert sharded_k.sharding.spec != PartitionSpec(*([None] * sharded_k.ndim)) or (
+        sharded_k.shape[-2] % len(mesh.devices.flat) != 0
+    )
+    info = backend.get_info()
+    assert info["mesh_devices"] == len(mesh.devices.flat)
+
+
+def test_mesh_sharded_server_is_token_identical_over_rpc(tmp_path):
+    """The same checkpoint served twice from one process — once mesh-sharded,
+    once single-device — through the same Server/RemoteSequential stack: greedy
+    decode produces IDENTICAL tokens (GSPMD may reorder reductions, so hidden
+    states match to tolerance and the argmax chain exactly)."""
+    from hivemind_tpu.moe import RemoteSequential
+
+    _write_checkpoint(tmp_path)
+    mesh = _tp_mesh()
+    backends_mesh, _ = load_llama_blocks(tmp_path, uid_prefix="meshed.", mesh=mesh)
+    backends_single, _ = load_llama_blocks(tmp_path, uid_prefix="single.")
+    dht = DHT(start=True)
+    server = Server(dht, {**backends_mesh, **backends_single}, decode_max_len=64)
+    client_dht = None
+    try:
+        server.run_in_background(await_ready=True)
+        time.sleep(1.0)
+        client_dht = DHT(initial_peers=[str(m) for m in dht.get_visible_maddrs()], start=True)
+        rng = np.random.RandomState(5)
+        prompt_len, steps = 6, 6
+        hidden = rng.randn(1, prompt_len, HID).astype(np.float32)
+
+        outputs = {}
+        for prefix in ("meshed.", "single."):
+            pipe = RemoteSequential(client_dht, prefix, LAYERS)
+            chunks = [np.asarray(pipe.decode_step(hidden, f"tok_{prefix}", reset=True))]
+            # greedy-style chain: each step feeds the previous step's output back,
+            # so ANY divergence compounds — the strongest identity check the
+            # hidden-state interface allows
+            for _ in range(steps):
+                chunks.append(
+                    np.asarray(pipe.decode_step(chunks[-1][:, -1:], f"tok_{prefix}"))
+                )
+            outputs[prefix] = np.concatenate(chunks, axis=1)
+
+        meshed, single = outputs["meshed."], outputs["single."]
+        assert meshed.shape == single.shape
+        # blocks COMPUTE in bf16 and GSPMD reorders reductions, and the feedback
+        # chain compounds the epsilon across 6 steps — the norm check is loose;
+        # the argmax chain below is the exact assertion
+        rel_err = np.linalg.norm(meshed - single) / np.linalg.norm(single)
+        assert rel_err < 3e-2, rel_err
+        # token-identical: a greedy head reading either stream picks the same ids
+        proj = rng.randn(HID, 64).astype(np.float32)  # a fixed surrogate LM head
+        assert np.array_equal(
+            np.argmax(meshed @ proj, axis=-1), np.argmax(single @ proj, axis=-1)
+        )
+    finally:
+        if client_dht is not None:
+            client_dht.shutdown()
+        server.shutdown()
+        dht.shutdown()
+
+
+def test_hbm_planning_7b_mesh_pooling():
+    """The regime the mesh tier exists for, at REAL 7B shapes: with a 600 MB
+    per-chip budget one chip cannot hold even one fp32 block, but an 8-device
+    mesh pools to several blocks — and the sharded per-device residency math
+    confirms each chip holds 1/8th of a block."""
+    config = LlamaCheckpointConfig(
+        hidden_size=4096, num_attention_heads=32, num_key_value_heads=32,
+        intermediate_size=11008, num_hidden_layers=32,
+    )
+    block = predict_block_param_bytes(config)
+    assert block > 700 * 1024**2  # ~810 MB fp32: genuinely 7B-scale
+
+    budget = 600 * 1024**2
+    cache = decode_cache_bytes(config, batch=1, max_len=512)
+    single = plan_block_capacity(
+        block, hbm_bytes=budget, decode_sessions=2, cache_bytes_per_session_block=cache
+    )
+    pooled = plan_block_capacity(
+        block, hbm_bytes=budget, decode_sessions=2, cache_bytes_per_session_block=cache,
+        mesh_devices=8,
+    )
+    assert single == 0, single  # one chip: not even one block
+    assert pooled >= 4, pooled  # the slice: several blocks

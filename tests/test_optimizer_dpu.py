@@ -197,8 +197,11 @@ def test_state_dict_roundtrip_with_schedule_replay():
 
 
 def test_one_epoch_grace_reload_rule():
-    """DPU peers trailing by exactly one epoch must NOT redownload state; two or
-    more epochs behind (or non-DPU peers one behind) must."""
+    """Peers trailing by exactly one epoch must NOT redownload state — in EVERY
+    mode (reference optimizer.py:654-672: the first peer to see enough samples
+    transitions and restarts the count, so global == local + 1 is normal network
+    asynchrony and the tracker reports the trailing peer ready to transition
+    itself). Two or more epochs behind must reload."""
     dht = DHT(start=True)
     opt = None
     try:
@@ -217,9 +220,13 @@ def test_one_epoch_grace_reload_rule():
         opt._pending_update = SimpleNamespace(done=lambda: False)
         assert not opt._should_load_state_from_peers()
         opt._pending_update = None
-        # non-DPU peers keep the strict rule
+        # non-DPU peers get the SAME one-epoch grace (r5 reference-parity fix:
+        # the old strict rule made sync peers discard progress and download
+        # state whenever a groupmate merely transitioned first)
         opt.delay_optimizer_step = False
         opt.tracker.global_epoch = 1
+        assert not opt._should_load_state_from_peers()
+        opt.tracker.global_epoch = 2
         assert opt._should_load_state_from_peers()
     finally:
         if opt is not None:
