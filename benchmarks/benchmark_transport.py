@@ -95,8 +95,16 @@ async def run_pair(args):
         relay_port = int(relay_proc.stdout.readline().strip().rsplit(" ", 1)[-1])
         relay_proc.stdout.readline()  # identity / encryption-unavailable line
 
-    server = await P2P.create()
+    # --via-daemon covers BOTH directions: the client's outbound dial rides the
+    # 'X' proxy and the server registers its public listener with the daemon
+    # ('Y'), so seal AND open both run in C++ (reference daemon role parity)
+    server = await P2P.create(
+        data_proxy_port=relay_port if args.via_daemon else None,
+        inbound_data_proxy=args.via_daemon,
+    )
     client = await P2P.create(data_proxy_port=relay_port if args.via_daemon else None)
+    if args.via_daemon:
+        assert server._inbound_proxy_active, "server-side ('Y') registration failed"
     received = await _add_sink(server)
 
     if args.relay:
@@ -125,7 +133,8 @@ async def run_pair(args):
             "streams": args.streams,
             "aead_threads": os.environ.get("HIVEMIND_AEAD_THREADS", "auto"),
             "path": ("relay splice + noise AEAD + mux, localhost" if args.relay
-                     else "native daemon data-plane proxy (C++ AEAD) + mux, localhost"
+                     else "native daemon data-plane proxy BOTH directions "
+                     "(client 'X' dial + server 'Y' listener, C++ AEAD) + mux, localhost"
                      if args.via_daemon
                      else "tcp + noise AEAD + mux, localhost"),
         },

@@ -43,6 +43,14 @@
 //             (consumed), frames #3+ are sealed toward the wire; wire frame #1
 //             (peer hello) crosses raw, #2+ are opened with recv_key. Ciphertext
 //             arriving before 'K' is held, so the upgrade cannot race.
+//   LISTEN    'Y' <u16 BE public_port> <u16 BE local_port> -> 'O' <u16 actual>.
+//             INBOUND data-plane proxy: the daemon binds the PUBLIC listener
+//             and forwards each accepted wire conn to the local server at
+//             127.0.0.1:local_port as a ProxyRemote/ProxyLocal pair — the same
+//             frame machine as 'X', fed by the responder-side handshake (hello
+//             #1, 'K' #2, sealed #3+), so a busy server's cipher work for BOTH
+//             directions leaves the Python event loop. The listener lives
+//             exactly as long as the control conn that registered it.
 // After 'O' on a DIAL/ACCEPT pair the two sockets are spliced byte-for-byte.
 //
 // Usage: relay_daemon [port] [identity_file] [unix_socket_path]
@@ -359,6 +367,12 @@ enum class ConnState {
   ProxyConnecting,  // outbound conn: awaiting connect() completion
   ProxyLocal,       // local side of an established proxy pair (plaintext frames)
   ProxyRemote,      // remote side (wire AEAD frames; holds the pair's keys)
+  // inbound listen-proxy ('Y'): the daemon owns the PUBLIC listener and pairs
+  // each accepted wire conn with a fresh loopback conn to the Python server —
+  // the same ProxyLocal/ProxyRemote frame machine then runs with the roles
+  // produced by the responder-side handshake (hello #1, 'K' #2, sealed #3+)
+  InboundRemoteWait,       // accepted wire conn: local leg still connecting
+  InboundLocalConnecting,  // daemon->server loopback conn: awaiting connect()
 };
 
 static constexpr size_t MAX_PROXY_FRAME = (16u << 20) + 16;  // crypto_channel MAX_FRAME_SIZE + tag
@@ -386,6 +400,7 @@ struct Conn {
   bool want_write = false;
   bool read_paused = false;  // EPOLLIN interest dropped (partner over HIGH_WATER)
   bool closing_after_flush = false;  // partner gone: close once outbuf drains
+  std::vector<int> owned_listeners;  // 'Y' listeners tied to this control conn
 };
 
 static int g_epoll = -1;
@@ -394,10 +409,25 @@ static unsigned char g_relay_pub[32] = {0};
 static std::map<int, Conn*> g_conns;
 static std::map<std::string, int> g_control;        // peer_id -> control fd
 static std::map<std::string, int> g_pending_dials;  // token -> dialer fd
+static std::map<int, uint16_t> g_inbound_listeners;  // 'Y' listener fd -> local port
 
 static void set_nonblock(int fd) {
   int flags = fcntl(fd, F_GETFL, 0);
   fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+static bool is_local_client(int fd) {
+  // the proxy control surface ('X'/'Y' and the 'K' key handoff) is local-only:
+  // AF_UNIX peers are local by construction; AF_INET peers must be loopback.
+  // (sockaddr_storage, NOT sockaddr_in: reading sin_addr from an AF_UNIX peer
+  // yields path bytes and mis-classifies every unix client.)
+  sockaddr_storage src{};
+  socklen_t slen = sizeof(src);
+  if (getpeername(fd, (sockaddr*)&src, &slen) != 0) return false;
+  if (src.ss_family == AF_UNIX) return true;
+  if (src.ss_family == AF_INET)
+    return (ntohl(((sockaddr_in*)&src)->sin_addr.s_addr) >> 24) == 127;
+  return false;
 }
 
 static void update_events(Conn* c) {
@@ -437,6 +467,13 @@ static void close_conn(int fd) {
   auto it = g_conns.find(fd);
   if (it == g_conns.end()) return;
   Conn* c = it->second;
+  for (int lfd : c->owned_listeners) {
+    // 'Y' listener lifetime is its owner control conn's: a dead server must not
+    // leave the daemon accepting wire conns nobody will answer
+    g_inbound_listeners.erase(lfd);
+    epoll_ctl(g_epoll, EPOLL_CTL_DEL, lfd, nullptr);
+    close(lfd);
+  }
   if (!c->peer_id.empty()) {
     auto reg = g_control.find(c->peer_id);
     if (reg != g_control.end() && reg->second == fd) g_control.erase(reg);
@@ -697,11 +734,8 @@ static void handle_control_frame(Conn* c, const std::string& payload) {
     // native seal/open). STRICTLY LOOPBACK-ONLY: this is a local data-plane
     // offload for co-resident peers — honoring it from a remote client would
     // turn every public relay into an open TCP proxy / SSRF vector.
-    sockaddr_in src{};
-    socklen_t slen = sizeof(src);
-    bool local_client = getpeername(c->fd, (sockaddr*)&src, &slen) == 0 &&
-                        (ntohl(src.sin_addr.s_addr) >> 24) == 127;
-    if (!local_client || c->peer_fd >= 0 || c->enc || !relay_crypto::channel_available) {
+    if (!is_local_client(c->fd) || c->peer_fd >= 0 || c->enc ||
+        !relay_crypto::channel_available) {
       refuse_and_close(c);
       return;
     }
@@ -730,6 +764,44 @@ static void handle_control_frame(Conn* c, const std::string& payload) {
     c->peer_fd = rfd;
     c->state = ConnState::ProxyLocalWait;
     c->created_ms = now_ms();
+  } else if (kind == 'Y' && payload.size() == 5) {
+    // inbound listen-proxy registration: 'Y' <u16 BE public_port> <u16 BE
+    // local_port> from a LOCAL server process. The daemon binds public_port
+    // (0 = ephemeral), replies 'O' <u16 BE actual_port>, and forwards every
+    // accepted wire conn to 127.0.0.1:local_port as a ProxyRemote/ProxyLocal
+    // pair — the server's AEAD then terminates HERE for both directions. The
+    // listener dies with this control conn.
+    if (!is_local_client(c->fd) || !relay_crypto::channel_available) {
+      refuse_and_close(c);
+      return;
+    }
+    uint16_t public_port = ((uint8_t)payload[1] << 8) | (uint8_t)payload[2];
+    uint16_t local_port = ((uint8_t)payload[3] << 8) | (uint8_t)payload[4];
+    int lfd = socket(AF_INET, SOCK_STREAM, 0);
+    if (lfd < 0) { refuse_and_close(c); return; }
+    int one = 1;
+    setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in baddr{};
+    baddr.sin_family = AF_INET;
+    baddr.sin_addr.s_addr = INADDR_ANY;
+    baddr.sin_port = htons(public_port);
+    if (bind(lfd, (sockaddr*)&baddr, sizeof(baddr)) < 0 || listen(lfd, 128) < 0) {
+      close(lfd);
+      refuse_and_close(c);
+      return;
+    }
+    set_nonblock(lfd);
+    socklen_t blen = sizeof(baddr);
+    getsockname(lfd, (sockaddr*)&baddr, &blen);
+    g_inbound_listeners[lfd] = local_port;
+    c->owned_listeners.push_back(lfd);
+    epoll_event lev{};
+    lev.events = EPOLLIN;
+    lev.data.fd = lfd;
+    epoll_ctl(g_epoll, EPOLL_CTL_ADD, lfd, &lev);
+    uint16_t actual = ntohs(baddr.sin_port);
+    char reply[3] = {'O', (char)(actual >> 8), (char)(actual & 0xff)};
+    queue_frame(c, std::string(reply, 3));
   } else if (kind == 'W') {
     sockaddr_in observed{};
     socklen_t olen = sizeof(observed);
@@ -787,11 +859,12 @@ static void on_readable(Conn* c) {
         update_events(c);
         break;
       }
-    } else if (c->state == ConnState::ProxyLocalWait) {
-      // outbound connect still in flight: buffer (the peer should be awaiting
-      // our 'O', so this is at most an eager hello)
+    } else if (c->state == ConnState::ProxyLocalWait ||
+               c->state == ConnState::InboundRemoteWait) {
+      // partner leg still connecting: buffer ('X' local: at most an eager
+      // hello; 'Y' wire: the initiator hello plus possibly its sealed confirm)
       c->inbuf.append(buf, n);
-      if (c->inbuf.size() > MAX_FRAME) { close_conn(c->fd); return; }
+      if (c->inbuf.size() > MAX_PROXY_FRAME + (1u << 20)) { close_conn(c->fd); return; }
     } else {
       c->inbuf.append(buf, n);
       while (c->state != ConnState::Spliced && c->inbuf.size() >= 4) {
@@ -827,6 +900,27 @@ static void maybe_resume_partner(Conn* c) {
 }
 
 static void on_writable(Conn* c) {
+  if (c->state == ConnState::InboundLocalConnecting) {
+    // daemon->server loopback leg of a 'Y' pair landed: the accepted wire conn
+    // becomes ProxyRemote (its buffered initiator hello/ciphertext drains
+    // through the shared frame machine) and this conn carries plaintext
+    int err = 0;
+    socklen_t elen = sizeof(err);
+    getsockopt(c->fd, SOL_SOCKET, SO_ERROR, &err, &elen);
+    auto pit = g_conns.find(c->peer_fd);
+    if (err != 0 || pit == g_conns.end()) { close_conn(c->fd); return; }
+    c->state = ConnState::ProxyLocal;
+    c->want_write = false;
+    int one = 1;
+    setsockopt(c->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    enable_keepalive(c->fd);
+    update_events(c);
+    Conn* wire = pit->second;
+    wire->state = ConnState::ProxyRemote;
+    enable_keepalive(wire->fd);
+    if (!wire->inbuf.empty()) proxy_process(wire);
+    return;
+  }
   if (c->state == ConnState::ProxyConnecting) {
     int err = 0;
     socklen_t elen = sizeof(err);
@@ -984,6 +1078,56 @@ int main(int argc, char** argv) {
         }
         continue;
       }
+      auto inbound_it = g_inbound_listeners.find(fd);
+      if (inbound_it != g_inbound_listeners.end()) {
+        // 'Y' public listener: pair every accepted wire conn with a fresh
+        // loopback connect to the registered server port
+        uint16_t local_port = inbound_it->second;
+        while (true) {
+          int wire = accept(fd, nullptr, nullptr);
+          if (wire < 0) break;
+          set_nonblock(wire);
+          setsockopt(wire, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          sockaddr_in laddr{};
+          laddr.sin_family = AF_INET;
+          laddr.sin_port = htons(local_port);
+          inet_pton(AF_INET, "127.0.0.1", &laddr.sin_addr);
+          int local = socket(AF_INET, SOCK_STREAM, 0);
+          bool ok = local >= 0;
+          if (ok) {
+            set_nonblock(local);
+            int rc = connect(local, (sockaddr*)&laddr, sizeof(laddr));
+            ok = rc == 0 || errno == EINPROGRESS;
+          }
+          if (!ok) {
+            if (local >= 0) close(local);
+            close(wire);
+            continue;
+          }
+          Conn* r = new Conn();
+          r->fd = wire;
+          r->state = ConnState::InboundRemoteWait;
+          r->created_ms = now_ms();
+          r->peer_fd = local;
+          g_conns[wire] = r;
+          epoll_event wev{};
+          wev.events = EPOLLIN;
+          wev.data.fd = wire;
+          epoll_ctl(g_epoll, EPOLL_CTL_ADD, wire, &wev);
+          Conn* l = new Conn();
+          l->fd = local;
+          l->state = ConnState::InboundLocalConnecting;
+          l->created_ms = now_ms();
+          l->peer_fd = wire;
+          l->want_write = true;
+          g_conns[local] = l;
+          epoll_event lev2{};
+          lev2.events = EPOLLOUT;
+          lev2.data.fd = local;
+          epoll_ctl(g_epoll, EPOLL_CTL_ADD, local, &lev2);
+        }
+        continue;
+      }
       auto it = g_conns.find(fd);
       if (it == g_conns.end()) continue;
       if (events[i].events & (EPOLLERR | EPOLLHUP)) { close_conn(fd); continue; }
@@ -1002,7 +1146,9 @@ int main(int argc, char** argv) {
       for (auto& [fd, conn] : g_conns) {
         if (conn->closing_after_flush && now_ms() - conn->created_ms > FLUSH_TTL_MS)
           expired.push_back(fd);
-        if ((conn->state == ConnState::ProxyConnecting || conn->state == ConnState::ProxyLocalWait)
+        if ((conn->state == ConnState::ProxyConnecting || conn->state == ConnState::ProxyLocalWait
+             || conn->state == ConnState::InboundRemoteWait
+             || conn->state == ConnState::InboundLocalConnecting)
             && now_ms() - conn->created_ms > PENDING_DIAL_TTL_MS)
           expired.push_back(fd);
       }

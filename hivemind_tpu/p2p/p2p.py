@@ -115,6 +115,7 @@ class P2P:
         max_connections: int = 0,
         data_proxy_port: Optional[int] = None,
         data_proxy_path: Optional[str] = None,
+        inbound_data_proxy: bool = False,
     ) -> "P2P":
         """``relays``: relay daemons to register at on startup (reference parity:
         p2p_daemon.py use_relay/use_auto_relay). Each spec is ``host:port`` or
@@ -161,6 +162,20 @@ class P2P:
             data_proxy_port = int(env_port) if env_port else None
         self._data_proxy_path = data_proxy_path or None
         self._data_proxy_port = data_proxy_port or None
+        self._proxied_dials = 0  # outbound dials that actually rode the daemon
+        # inbound data-plane proxy ('Y'): the DAEMON owns the public listener and
+        # forwards wire conns to a loopback server here; inbound AEAD then also
+        # terminates in C++ (the reference daemon owns both directions,
+        # p2p_daemon.py:84-147). Requires a data proxy endpoint; falls back to
+        # direct listening if the daemon refuses.
+        if not inbound_data_proxy:
+            inbound_data_proxy = os.environ.get("HIVEMIND_TPU_INBOUND_DATA_PROXY", "0") == "1"
+        self._inbound_proxy_requested = bool(inbound_data_proxy) and (
+            self._data_proxy_port is not None or self._data_proxy_path is not None
+        )
+        self._inbound_proxy_active = False
+        self._inbound_proxy_writer: Optional[asyncio.StreamWriter] = None
+        self._announce_port_from_proxy = False
         self._bg_tasks: Set[asyncio.Task] = set()  # strong refs: loop holds tasks weakly
         self._alive_refs = 1  # P2P.replicate parity: shared instance refcount
         self._peer_resolver = None  # optional async fallback route lookup (auto-relay)
@@ -174,11 +189,34 @@ class P2P:
         self._announce_port = announce_port
 
         self._server = None
+        self._requested_listen_port = listen_port
         try:
-            self._server = await asyncio.start_server(
-                self._on_inbound_connection, listen_host, listen_port
-            )
-            self._listen_port = self._server.sockets[0].getsockname()[1]
+            if self._inbound_proxy_requested:
+                # bind LOOPBACK only: the public listener belongs to the daemon
+                self._server = await asyncio.start_server(
+                    self._on_inbound_connection, "127.0.0.1", 0
+                )
+                local_port = self._server.sockets[0].getsockname()[1]
+                public_port = await self._register_inbound_proxy(listen_port, local_port)
+                if public_port is not None:
+                    self._inbound_proxy_active = True
+                    self._listen_port = local_port
+                    if self._announce_port is None:
+                        self._announce_port = public_port
+                        self._announce_port_from_proxy = True
+                    logger.debug(
+                        f"P2P {self.peer_id} behind the daemon's inbound proxy: "
+                        f"public :{public_port} -> loopback :{local_port}"
+                    )
+                else:
+                    logger.warning(
+                        "inbound data-plane proxy registration failed; "
+                        "falling back to direct listening"
+                    )
+                    self._server.close()
+                    await self._start_direct_server()
+            else:
+                await self._start_direct_server()
             logger.debug(f"P2P {self.peer_id} listening on {listen_host}:{self._listen_port}")
 
             for maddr in initial_peers:
@@ -294,6 +332,78 @@ class P2P:
 
     # ------------------------------------------------------------------ connections
 
+    async def _start_direct_server(self) -> None:
+        """Bind the ordinary public listener (initial create, proxy-registration
+        failure, and daemon-death fallback all share this)."""
+        self._server = await asyncio.start_server(
+            self._on_inbound_connection, self._listen_host, self._requested_listen_port
+        )
+        self._listen_port = self._server.sockets[0].getsockname()[1]
+
+    async def _open_daemon_connection(self):
+        """One framed connection to the local proxy daemon (unix socket wins)."""
+        if self._data_proxy_path is not None:
+            return await asyncio.open_unix_connection(self._data_proxy_path)
+        return await asyncio.open_connection("127.0.0.1", self._data_proxy_port)
+
+    async def _register_inbound_proxy(self, public_port: int, local_port: int) -> Optional[int]:
+        """Ask the daemon to own our PUBLIC listener ('Y' frame) and forward wire
+        conns to ``local_port``; returns the actual public port, or None on
+        refusal. The control connection stays open — the daemon ties the
+        listener's lifetime to it."""
+        import struct
+
+        try:
+            reader, writer = await asyncio.wait_for(self._open_daemon_connection(), timeout=5.0)
+            request = b"Y" + struct.pack(">HH", public_port, local_port)
+            writer.write(struct.pack(">I", len(request)) + request)
+            await writer.drain()
+            header = await asyncio.wait_for(reader.readexactly(4), timeout=5.0)
+            (length,) = struct.unpack(">I", header)
+            response = await asyncio.wait_for(reader.readexactly(length), timeout=5.0)
+            if len(response) == 3 and response[0:1] == b"O":
+                self._inbound_proxy_writer = writer
+                # the daemon ties the public listener to this conn: watch it —
+                # a daemon crash otherwise leaves us announcing a dead port
+                # forever while outbound dials keep working and mask the loss
+                watchdog = asyncio.create_task(self._watch_inbound_proxy(reader))
+                self._bg_tasks.add(watchdog)
+                watchdog.add_done_callback(self._bg_tasks.discard)
+                return struct.unpack(">H", response[1:3])[0]
+            writer.close()
+        except (ConnectionError, OSError, asyncio.TimeoutError, asyncio.IncompleteReadError) as e:
+            logger.debug(f"inbound proxy registration failed: {e!r}")
+        return None
+
+    async def _watch_inbound_proxy(self, reader: asyncio.StreamReader) -> None:
+        """EOF on the 'Y' control conn means the daemon (and our public listener)
+        died: fall back to DIRECT listening and re-announce, loudly."""
+        try:
+            while await reader.read(4096):
+                pass  # the daemon sends nothing after 'O'; drain defensively
+        except (ConnectionError, OSError, asyncio.CancelledError):
+            pass
+        if self._shutting_down or not self._inbound_proxy_active:
+            return
+        logger.warning(
+            "the data-plane proxy daemon died: its public listener is gone; "
+            "falling back to a DIRECT listener and re-announcing"
+        )
+        self._inbound_proxy_active = False
+        self._inbound_proxy_writer = None
+        if self._announce_port_from_proxy:
+            self._announce_port = None
+            self._announce_port_from_proxy = False
+        old_server = self._server
+        try:
+            await self._start_direct_server()
+        except OSError as e:
+            logger.error(f"direct-listener fallback failed: {e!r}; this peer is undialable")
+            return
+        if old_server is not None:
+            old_server.close()  # in-flight loopback conns finish on their transports
+        logger.warning(f"now listening directly on {self._listen_host}:{self._listen_port}")
+
     async def _on_inbound_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         if self._shutting_down:
             writer.close()
@@ -302,6 +412,9 @@ class P2P:
             channel, extras = await handshake(
                 reader, writer, self.identity, is_initiator=False,
                 announced_addrs=self.get_visible_maddrs(),
+                # behind the daemon's listener EVERY inbound conn is a proxy
+                # local leg: hand it the session keys and go plaintext here
+                proxy_upgrade=self._inbound_proxy_active,
             )
         except (HandshakeError, asyncio.TimeoutError, asyncio.IncompleteReadError, ConnectionError, OSError) as e:
             logger.debug(f"inbound handshake failed: {e!r}")
@@ -452,11 +565,8 @@ class P2P:
             if not infos:
                 raise ConnectionError(f"no IPv4 address for {host!r} (data-plane proxy is IPv4-only)")
             host = infos[0][4][0]
-        if self._data_proxy_path is not None:
-            # the 0600 unix socket is the key-handoff trust boundary (see create)
-            reader, writer = await asyncio.open_unix_connection(self._data_proxy_path)
-        else:
-            reader, writer = await asyncio.open_connection("127.0.0.1", self._data_proxy_port)
+        # the 0600 unix socket is the key-handoff trust boundary (see create)
+        reader, writer = await self._open_daemon_connection()
         request = b"X" + struct.pack(">H", port) + host.encode()
         writer.write(struct.pack(">I", len(request)) + request)
         await writer.drain()
@@ -468,6 +578,7 @@ class P2P:
             raise ConnectionError(
                 f"data-plane proxy could not reach {host}:{port} (reply {response!r})"
             )
+        self._proxied_dials += 1
         return reader, writer
 
     def _close_after_grace(self, conn: MuxConnection, grace: float = 30.0) -> None:
@@ -707,6 +818,10 @@ class P2P:
             return
         self._shutting_down = True
         self._server.close()
+        if self._inbound_proxy_writer is not None:
+            # closing the control conn tears down the daemon's public listener
+            self._inbound_proxy_writer.close()
+            self._inbound_proxy_writer = None
         for relay in self._relays:
             await relay.close()
         self._relays.clear()
