@@ -487,12 +487,19 @@ class P2P:
         conn = await self._dial(maddr, expected_peer=maddr.peer_id)
         return conn.peer_id
 
+    _DIALABLE_PROTOS = frozenset({"ip4", "ip6", "dns", "dns4", "dns6"})
+
     async def _dial(
         self, maddr: Multiaddr, expected_peer: Optional[PeerID], replace_existing: bool = False
     ) -> MuxConnection:
         """Dial one address. With ``replace_existing`` a live connection to the same
         peer is superseded for FUTURE streams (hole-punch upgrade: the direct path
         replaces the relayed one; in-flight streams finish on the old connection)."""
+        if maddr.host_proto not in self._DIALABLE_PROTOS:
+            # peer-announced unix/onion3 addresses parse (codec parity) but the
+            # TCP transport cannot reach them — fail INSTANTLY so an attacker
+            # announcing them cannot burn a dial timeout per reconnect attempt
+            raise ConnectionError(f"no transport for {maddr.host_proto!r} address {maddr}")
         via_proxy = self._data_proxy_port is not None or self._data_proxy_path is not None
         if via_proxy:
             try:

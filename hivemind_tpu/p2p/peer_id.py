@@ -104,7 +104,12 @@ class PeerID:
 
 class Multiaddr:
     """Minimal multiaddr: /<host_proto>/<host>/tcp/<port>[/p2p/<peer_id>] with
-    host_proto one of ip4/ip6/dns/dns4/dns6."""
+    host_proto one of ip4/ip6/dns/dns4/dns6 — plus the reference's vendored
+    codec extras (hivemind/utils/multiaddr/): ``/unix/<path>`` (the path
+    consumes the remainder, go-multiaddr semantics) and
+    ``/onion3/<56-char-base32>:<port>``. Codec parity only: the TCP transport
+    dials ip/dns addresses; unix/onion3 addresses round-trip through configs
+    and DHT records."""
 
     __slots__ = ("host", "port", "peer_id", "host_proto")
 
@@ -133,6 +138,26 @@ class Multiaddr:
                     port = int(value)
                 elif proto == "p2p":
                     peer_id = PeerID.from_base58(value)
+                elif proto == "unix":
+                    # the path consumes the remainder (go-multiaddr semantics) —
+                    # except a trailing /p2p/<id>, which stays the peer identity
+                    # so with_peer_id round-trips through str/parse
+                    rest = parts[i + 1:]
+                    if len(rest) >= 2 and rest[-2] == "p2p":
+                        try:
+                            peer_id = PeerID.from_base58(rest[-1])
+                            rest = rest[:-2]
+                        except Exception:
+                            pass  # a path that merely LOOKS like /p2p/<junk>
+                    host, host_proto = "/" + "/".join(rest), "unix"
+                    return cls(host, 0, peer_id, host_proto)
+                elif proto == "onion3":
+                    addr, sep, onion_port = value.partition(":")
+                    if not sep or len(addr) != 56:
+                        raise ValueError(
+                            f"onion3 address must be <56-char-base32>:<port>, got {value!r}"
+                        )
+                    host, host_proto, port = addr, "onion3", int(onion_port)
                 else:
                     raise ValueError(f"unsupported multiaddr protocol {proto!r} in {text!r}")
             except ValueError:
@@ -152,7 +177,12 @@ class Multiaddr:
         return (self.host, self.port)
 
     def __str__(self) -> str:
-        base = f"/{self.host_proto}/{self.host}/tcp/{self.port}"
+        if self.host_proto == "unix":
+            base = f"/unix{self.host}"
+        elif self.host_proto == "onion3":
+            base = f"/onion3/{self.host}:{self.port}"
+        else:
+            base = f"/{self.host_proto}/{self.host}/tcp/{self.port}"
         if self.peer_id is not None:
             base += f"/p2p/{self.peer_id.to_base58()}"
         return base
@@ -161,12 +191,15 @@ class Multiaddr:
         return f"Multiaddr({self})"
 
     def __eq__(self, other) -> bool:
+        # host_proto matters: /onion3/<x>:9443 and /dns/<x>/tcp/9443 share host
+        # and port but are DIFFERENT addresses (peerstores are Set[Multiaddr])
         return (
             isinstance(other, Multiaddr)
             and self.host == other.host
             and self.port == other.port
             and self.peer_id == other.peer_id
+            and self.host_proto == other.host_proto
         )
 
     def __hash__(self) -> int:
-        return hash((self.host, self.port, self.peer_id))
+        return hash((self.host, self.port, self.peer_id, self.host_proto))

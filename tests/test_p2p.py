@@ -39,6 +39,22 @@ def test_peer_id_and_multiaddr():
     with pytest.raises(ValueError):
         Multiaddr.parse("/udp/53")
 
+    # reference vendored-multiaddr codec extras: unix + onion3 round-trip
+    unix = Multiaddr.parse("/unix/tmp/sockets/p2p.sock")
+    assert unix.host_proto == "unix" and unix.host == "/tmp/sockets/p2p.sock"
+    assert Multiaddr.parse(str(unix)) == unix
+    # ...including a pinned peer identity (hole-punch serialization reparses str)
+    unix_pid = unix.with_peer_id(pid)
+    assert Multiaddr.parse(str(unix_pid)) == unix_pid
+    onion_host = "a" * 56
+    onion = Multiaddr.parse(f"/onion3/{onion_host}:9443")
+    assert onion.host_proto == "onion3" and onion.host == onion_host and onion.port == 9443
+    assert Multiaddr.parse(str(onion)) == onion
+    # protocols are part of identity: same host+port, different proto, distinct
+    assert onion != Multiaddr.parse(f"/dns/{onion_host}/tcp/9443")
+    with pytest.raises(ValueError):
+        Multiaddr.parse("/onion3/tooshort:1")
+
 
 async def test_p2p_lifecycle_and_identity(tmp_path):
     ident = str(tmp_path / "id.key")
