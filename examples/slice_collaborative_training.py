@@ -51,6 +51,10 @@ def main():
     parser.add_argument("--dim", type=int, default=64)
     parser.add_argument("--learning_rate", type=float, default=0.05)
     parser.add_argument("--target_group_size", type=int, default=2)
+    parser.add_argument("--delay_grad_averaging", action="store_true",
+                        help="overlap swarm rounds with training (the DPU mode: "
+                             "the round runs in the background and its update "
+                             "lands one epoch stale — the mesh never stalls)")
     from hivemind_tpu.utils.platform import add_platform_arg, apply_platform
 
     add_platform_arg(parser)
@@ -117,6 +121,7 @@ def main():
         run_id=args.run_id, target_batch_size=args.target_batch_size,
         batch_size_per_step=args.batch_size,
         target_group_size=args.target_group_size, matchmaking_time=1.5,
+        delay_grad_averaging=args.delay_grad_averaging,
         verbose=True,
     )
 

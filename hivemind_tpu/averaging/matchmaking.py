@@ -79,11 +79,14 @@ class Matchmaking:
         # without an operator re-sizing the lead time.
         self.fill_latency_ema: Optional[float] = None
         self._lead_backoff = 1.0
-        # set whenever another declared averager (or an inbound join request) is
-        # seen during the current window: a group-less round with NOBODY to match
-        # with is the legitimate solo-swarm case and must not ratchet the backoff
-        # (advisor r4: a peer starting before its swarm would otherwise arrive at
-        # the 30 s cap and slow its first real group formation)
+        # set once another declared averager (or an inbound join request) has
+        # EVER been seen — sticky on purpose: a group-less expiry before anyone
+        # was ever observed is the legitimate solo-startup case and must not
+        # ratchet the backoff (advisor r4: a peer starting before its swarm
+        # would otherwise arrive at the 30 s cap and slow its first real group
+        # formation), while after first contact an expiry is contention evidence
+        # even if THIS window's fetch transiently saw nobody (DHT fetch latency
+        # under load)
         self._others_observed = False
 
     def suggested_lead_time(self) -> float:
@@ -128,7 +131,6 @@ class Matchmaking:
             self.data_for_gather = data_for_gather
             self.assembled_group = None
             self._tried_leaders.clear()
-            self._others_observed = False
             now = get_dht_time()
             self.declared_expiration_time = max(
                 scheduled_time if scheduled_time is not None else now + self.min_matchmaking_time,

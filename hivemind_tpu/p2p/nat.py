@@ -55,6 +55,9 @@ class NATTraversal:
         for addr in addrs:
             try:
                 maddr = Multiaddr.parse(addr)
+                if maddr.host_proto not in self.p2p._DIALABLE_PROTOS:
+                    continue  # unix/onion3 parse (codec parity) but cannot be
+                    # probed over TCP — and must not burn PROBE_TIMEOUT each
                 _reader, writer = await asyncio.wait_for(
                     asyncio.open_connection(maddr.host, maddr.port), timeout=PROBE_TIMEOUT
                 )

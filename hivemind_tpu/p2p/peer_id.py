@@ -144,11 +144,18 @@ class Multiaddr:
                     # so with_peer_id round-trips through str/parse
                     rest = parts[i + 1:]
                     if len(rest) >= 2 and rest[-2] == "p2p":
+                        # only a REAL sha2-256 multihash identity strips the
+                        # tail: base58 alone is not enough (a path like
+                        # /var/run/p2p/sock has an all-base58 last segment and
+                        # must stay a path)
                         try:
-                            peer_id = PeerID.from_base58(rest[-1])
-                            rest = rest[:-2]
+                            candidate = PeerID.from_base58(rest[-1])
+                            raw = candidate.to_bytes()
+                            if len(raw) == 34 and raw[0] == 0x12 and raw[1] == 0x20:
+                                peer_id = candidate
+                                rest = rest[:-2]
                         except Exception:
-                            pass  # a path that merely LOOKS like /p2p/<junk>
+                            pass
                     host, host_proto = "/" + "/".join(rest), "unix"
                     return cls(host, 0, peer_id, host_proto)
                 elif proto == "onion3":

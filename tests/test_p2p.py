@@ -52,6 +52,10 @@ def test_peer_id_and_multiaddr():
     assert Multiaddr.parse(str(onion)) == onion
     # protocols are part of identity: same host+port, different proto, distinct
     assert onion != Multiaddr.parse(f"/dns/{onion_host}/tcp/9443")
+    # a path whose last segments merely LOOK base58 stays a path (only a real
+    # sha2-256 multihash identity is stripped as /p2p/<id>)
+    plain_path = Multiaddr.parse("/unix/var/run/p2p/sock")
+    assert plain_path.host == "/var/run/p2p/sock" and plain_path.peer_id is None
     with pytest.raises(ValueError):
         Multiaddr.parse("/onion3/tooshort:1")
 
