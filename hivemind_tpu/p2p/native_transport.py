@@ -1,0 +1,138 @@
+"""Zero-config native transport: spawn a PRIVATE relay daemon for this process
+and wire the data-plane proxy through it — both directions.
+
+The reference never runs without its native daemon (hivemind/p2p/p2p_daemon.py
+spawns p2pd at startup and terminates the whole transport there, :84-147). Here
+the native tier is optional — the pure-asyncio transport is complete — but
+``P2P.create(native_transport=True)`` reproduces the reference's default
+posture with one flag: a daemon child is spawned (building it from source if
+needed), listening ONLY on a 0600 AF_UNIX socket (the key-handoff trust
+boundary; no TCP control port is opened), and the P2P routes outbound dials
+('X') and its public listener ('Y') through it, so ChaCha20-Poly1305 for both
+directions runs in C++ outside the Python event loop.
+
+The daemon's lifetime is tied to the P2P: `shutdown()` kills it, and if it dies
+first the inbound watchdog falls back to direct listening (see
+`P2P._watch_inbound_proxy`) while outbound dials degrade to plain sockets."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Optional
+
+from hivemind_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+NATIVE_DIR = Path(__file__).parent.parent / "native"
+
+
+class NativeTransportDaemon:
+    """A private relay daemon child serving the data-plane proxy over a 0600
+    unix socket. Use :func:`spawn_native_transport`."""
+
+    def __init__(
+        self, process: subprocess.Popen, unix_path: str, port: int,
+        workdir: str, owns_workdir: bool,
+    ):
+        self.process = process
+        self.unix_path = unix_path
+        self.port = port  # the daemon's TCP control port (relay/'Y' listeners ride it too)
+        self._workdir = workdir
+        self._owns_workdir = owns_workdir
+
+    @property
+    def alive(self) -> bool:
+        return self.process.poll() is None
+
+    def shutdown(self) -> None:
+        if self.process.poll() is None:
+            self.process.kill()
+            self.process.wait()
+        try:
+            os.unlink(self.unix_path)
+        except OSError:
+            pass
+        if self._owns_workdir:
+            import shutil
+
+            shutil.rmtree(self._workdir, ignore_errors=True)
+
+
+def _die_with_parent():
+    """Child pre-exec: SIGKILL on parent death (Linux PR_SET_PDEATHSIG), so an
+    OOM-killed or SIGKILLed trainer cannot orphan a daemon with open listeners
+    (the graceful path still reaps via shutdown())."""
+    try:
+        import ctypes
+        import signal
+
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        libc.prctl(1, signal.SIGKILL)  # 1 = PR_SET_PDEATHSIG
+    except Exception:
+        pass  # non-Linux / no libc: best effort only
+
+
+def spawn_native_transport(
+    workdir: Optional[str] = None, banner_timeout: float = 30.0
+) -> Optional[NativeTransportDaemon]:
+    """Build (if needed) and spawn the relay daemon with a fresh unix socket.
+    Returns None — with a warning — when the native toolchain or binary is
+    unavailable, so callers can degrade to the pure-asyncio transport.
+
+    BLOCKING (the build can take tens of seconds on a slow host): async callers
+    must run this in an executor — ``P2P.create`` does."""
+    binary = NATIVE_DIR / "relay_daemon"
+    if (NATIVE_DIR / "relay_daemon.cpp").exists():
+        build = subprocess.run(["make"], cwd=NATIVE_DIR, capture_output=True, text=True)
+        if build.returncode != 0:
+            logger.warning(
+                f"native transport build failed; staying on the asyncio data "
+                f"plane:\n{build.stderr[-500:]}"
+            )
+            return None
+    if not binary.exists():
+        logger.warning("no relay daemon binary; staying on the asyncio data plane")
+        return None
+
+    owns_workdir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="hivemind_native_")
+    unix_path = os.path.join(workdir, "data_plane.sock")
+    process = subprocess.Popen(
+        [str(binary), "0", "", unix_path],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        preexec_fn=_die_with_parent,
+    )
+
+    def _give_up(reason: str) -> None:
+        process.kill()
+        process.wait()
+        if owns_workdir:
+            import shutil
+
+            shutil.rmtree(workdir, ignore_errors=True)
+        logger.warning(f"{reason}; staying on the asyncio data plane")
+
+    # the daemon prints exactly two startup lines in one flush (see its main());
+    # a bounded select guards against a child that wedges pre-banner
+    import select
+
+    ready, _, _ = select.select([process.stdout], [], [], banner_timeout)
+    if not ready:
+        _give_up(f"daemon produced no banner within {banner_timeout:.0f}s")
+        return None
+    first = process.stdout.readline().strip()
+    process.stdout.readline()
+    try:
+        port = int(first.rsplit(" ", 1)[-1])
+    except ValueError:
+        _give_up(f"unexpected daemon banner {first!r}")
+        return None
+    if not os.path.exists(unix_path):
+        _give_up("daemon did not create its unix socket")
+        return None
+    logger.debug(f"private data-plane daemon up (pid {process.pid}, socket {unix_path})")
+    return NativeTransportDaemon(process, unix_path, port, workdir, owns_workdir)

@@ -116,6 +116,7 @@ class P2P:
         data_proxy_port: Optional[int] = None,
         data_proxy_path: Optional[str] = None,
         inbound_data_proxy: bool = False,
+        native_transport: Optional[bool] = None,
     ) -> "P2P":
         """``relays``: relay daemons to register at on startup (reference parity:
         p2p_daemon.py use_relay/use_auto_relay). Each spec is ``host:port`` or
@@ -160,6 +161,23 @@ class P2P:
         if data_proxy_port is None:
             env_port = os.environ.get("HIVEMIND_TPU_DATA_PROXY_PORT")
             data_proxy_port = int(env_port) if env_port else None
+        # zero-config native tier (the reference's default posture: the whole
+        # transport terminates in its spawned daemon, p2p_daemon.py:84-147): spawn
+        # a PRIVATE daemon on a 0600 unix socket and route both directions
+        # through it; a failed spawn degrades to the pure-asyncio transport
+        self._native_daemon = None
+        if native_transport is None:  # None = env decides; explicit False wins over env
+            native_transport = os.environ.get("HIVEMIND_TPU_NATIVE_TRANSPORT", "0") == "1"
+        if native_transport and data_proxy_path is None and data_proxy_port is None:
+            from hivemind_tpu.p2p.native_transport import spawn_native_transport
+
+            # the spawn may BUILD the daemon (tens of seconds): keep the loop live
+            self._native_daemon = await asyncio.get_running_loop().run_in_executor(
+                None, spawn_native_transport
+            )
+            if self._native_daemon is not None:
+                data_proxy_path = self._native_daemon.unix_path
+                inbound_data_proxy = True
         self._data_proxy_path = data_proxy_path or None
         self._data_proxy_port = data_proxy_port or None
         self._proxied_dials = 0  # outbound dials that actually rode the daemon
@@ -256,6 +274,8 @@ class P2P:
                     pass  # best-effort: cancellation must not strand later closes
             if self._identity_lock_fd is not None:
                 os.close(self._identity_lock_fd)
+            if self._native_daemon is not None:
+                self._native_daemon.shutdown()
             raise
         return self
 
@@ -829,6 +849,9 @@ class P2P:
             # closing the control conn tears down the daemon's public listener
             self._inbound_proxy_writer.close()
             self._inbound_proxy_writer = None
+        if self._native_daemon is not None:
+            self._native_daemon.shutdown()
+            self._native_daemon = None
         for relay in self._relays:
             await relay.close()
         self._relays.clear()

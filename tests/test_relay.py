@@ -493,6 +493,39 @@ async def test_inbound_data_plane_proxy(relay_process):
         await server.shutdown()
 
 
+async def test_native_transport_zero_config():
+    """`P2P.create(native_transport=True)` reproduces the reference's default
+    posture with one flag: a PRIVATE daemon spawns on a 0600 unix socket, the
+    public listener moves into it ('Y'), outbound dials ride 'X', and shutdown
+    reaps the child — no ports, paths, or daemon management for the caller."""
+    server = await P2P.create(native_transport=True)
+    if server._native_daemon is None:
+        await server.shutdown()
+        pytest.skip("native toolchain unavailable: the designed asyncio fallback engaged")
+    client = await P2P.create(native_transport=True)
+    try:
+        assert server._native_daemon is not None and server._native_daemon.alive
+        assert server._inbound_proxy_active
+        assert (os.stat(server._native_daemon.unix_path).st_mode & 0o777) == 0o600
+
+        async def echo(request: test_pb2.TestRequest, context: P2PContext) -> test_pb2.TestResponse:
+            return test_pb2.TestResponse(number=request.number + 100)
+
+        await server.add_protobuf_handler("echo", echo, test_pb2.TestRequest)
+        await client.connect(server.get_visible_maddrs()[0])
+        response = await client.call_protobuf_handler(
+            server.peer_id, "echo", test_pb2.TestRequest(number=1), test_pb2.TestResponse
+        )
+        assert response.number == 101
+        assert client._proxied_dials >= 1  # the dial rode the client's own daemon
+    finally:
+        server_proc = server._native_daemon.process if server._native_daemon else None
+        await client.shutdown()
+        await server.shutdown()
+        if server_proc is not None:
+            assert server_proc.poll() is not None, "daemon child leaked past shutdown"
+
+
 async def test_inbound_proxy_daemon_death_falls_back_to_direct_listening():
     """If the daemon dies AFTER 'Y' registration, its public listener vanishes —
     the peer must notice (EOF watchdog on the control conn), fall back to a
