@@ -80,13 +80,13 @@ class Matchmaking:
         self.fill_latency_ema: Optional[float] = None
         self._lead_backoff = 1.0
         # set once another declared averager (or an inbound join request) has
-        # EVER been seen — sticky on purpose: a group-less expiry before anyone
-        # was ever observed is the legitimate solo-startup case and must not
-        # ratchet the backoff (advisor r4: a peer starting before its swarm
-        # would otherwise arrive at the 30 s cap and slow its first real group
-        # formation), while after first contact an expiry is contention evidence
-        # even if THIS window's fetch transiently saw nobody (DHT fetch latency
-        # under load)
+        # EVER been seen. Backoff applies to EVERY window expiry — under a
+        # 32-peer declare storm that unconditional stretch is what lets the
+        # swarm converge (gating it on per-window observations regressed the
+        # storm case to success 0.48, RESULTS.md) — but FIRST CONTACT resets it:
+        # a peer that started before its swarm may have ratcheted to the cap
+        # while alone (harmless: nobody to match with), and must form its first
+        # real group at the base lead time, not 30 s later (advisor r4)
         self._others_observed = False
 
     def suggested_lead_time(self) -> float:
@@ -108,10 +108,15 @@ class Matchmaking:
                 else 0.7 * self.fill_latency_ema + 0.3 * latency
             )
             self._lead_backoff = max(1.0, self._lead_backoff / 2.0)
-        elif self._others_observed:
-            # only a CONTENDED failure (peers were around, window still expired)
-            # is evidence the lead time is too short
+        else:
             self._lead_backoff = min(self._lead_backoff * 2.0, 16.0)
+
+    def _note_others_observed(self) -> None:
+        """First contact with the swarm: discard any solo-era backoff so the
+        first REAL group forms at the base lead time (see __init__ notes)."""
+        if not self._others_observed:
+            self._others_observed = True
+            self._lead_backoff = 1.0
 
     @property
     def is_looking_for_group(self) -> bool:
@@ -216,7 +221,7 @@ class Matchmaking:
         for peer_id, expiration in candidates:
             if peer_id == self.peer_id:
                 continue
-            self._others_observed = True
+            self._note_others_observed()
             if peer_id in self._tried_leaders:
                 continue
             if expiration <= now or expiration >= self.declared_expiration_time:
@@ -307,7 +312,7 @@ class Matchmaking:
             yield reject
             return
         outbox: asyncio.Queue = asyncio.Queue()
-        self._others_observed = True
+        self._note_others_observed()
         self.current_followers[context.remote_id] = (request, outbox)
         try:
             yield averaging_pb2.MessageFromLeader(code=averaging_pb2.ACCEPTED)

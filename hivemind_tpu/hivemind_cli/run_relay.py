@@ -50,55 +50,42 @@ def main():
             "local testing, useless to any peer on another machine"
         )
 
-    binary = NATIVE_DIR / "relay_daemon"
-    if (NATIVE_DIR / "relay_daemon.cpp").exists():
-        # make's own dependency rule handles staleness (no-op when fresh); a stale
-        # binary could predate the two-startup-line protocol parsed below
-        build = subprocess.run(["make"], cwd=NATIVE_DIR, capture_output=True, text=True)
-        if build.returncode != 0:
-            raise RuntimeError(f"relay daemon build failed:\n{build.stderr[-2000:]}")
-    elif not binary.exists():
-        raise RuntimeError(f"no relay daemon binary or source under {NATIVE_DIR}")
+    from hivemind_tpu.p2p.native_transport import build_daemon_binary, read_daemon_banner
+
+    # the shared helper serializes concurrent makes with an flock and treats a
+    # missing toolchain as an error message (an operator CLI raises on it)
+    binary, error = build_daemon_binary()
+    if binary is None:
+        raise RuntimeError(f"relay daemon unavailable under {NATIVE_DIR}: {error}")
 
     daemon = subprocess.Popen(
         [str(binary), str(args.relay_port), args.identity_path],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
     )
-    first_line = daemon.stdout.readline().strip()
-    if not first_line:  # daemon died before announcing (e.g. port already bound)
-        daemon.wait(timeout=5)
+    # a current daemon emits exactly two startup lines in one flush; the bounded
+    # read guards a STALE prebuilt binary from before the two-line protocol —
+    # hanging forever there would be worse than erroring. Anything unexpected is
+    # an error: a crypto-capable relay advertised WITHOUT its identity would
+    # silently downgrade every NATed peer to unpinned registration.
+    banner = read_daemon_banner(daemon, timeout=10.0)
+    if banner is None:
+        returncode = daemon.poll()
+        stderr_tail = ""
+        if returncode is not None:  # died before announcing (e.g. port bound)
+            stderr_tail = daemon.stderr.read()[-500:]
+        daemon.kill()
+        daemon.wait()
         raise RuntimeError(
-            f"relay daemon exited at startup (rc={daemon.returncode}): "
-            f"{daemon.stderr.read()[-500:]}"
+            "relay daemon did not announce its two startup lines within 10s"
+            + (f" (rc={returncode}): {stderr_tail}" if returncode is not None
+               else " — a stale binary predates the protocol; rebuild (make -C native)")
         )
+    first_line, identity_line = banner
     try:
         port = int(first_line.rsplit(" ", 1)[-1])
     except ValueError:
         daemon.kill()
         raise RuntimeError(f"unexpected relay daemon output: {first_line!r}") from None
-    # a current daemon emits exactly two startup lines in one flush ("relay
-    # identity <hex>" or "relay encryption unavailable"), so the readline cannot
-    # race the stream buffer; the thread-side timeout only guards a STALE prebuilt
-    # binary from before the two-line protocol (binary-only deployment, no
-    # rebuild) — hanging forever there would be worse than erroring. Anything
-    # unexpected is an error: a crypto-capable relay advertised WITHOUT its
-    # identity would silently downgrade every NATed peer to unpinned registration.
-    import queue as queue_module
-    import threading
-
-    line_queue: "queue_module.Queue[str]" = queue_module.Queue()
-    reader_thread = threading.Thread(
-        target=lambda: line_queue.put(daemon.stdout.readline()), daemon=True
-    )
-    reader_thread.start()
-    try:
-        identity_line = line_queue.get(timeout=10.0).strip()
-    except queue_module.Empty:
-        daemon.kill()
-        raise RuntimeError(
-            "relay daemon did not announce its identity line within 10s — the binary "
-            "predates the two-startup-line protocol; rebuild it (make -C native)"
-        ) from None
     if identity_line.startswith("relay identity "):
         pubkey_hex = identity_line.rsplit(" ", 1)[-1]
         logger.info(f"relay daemon up on port {port} (identity {pubkey_hex[:16]}…)")

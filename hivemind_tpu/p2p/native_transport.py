@@ -76,6 +76,61 @@ def _die_with_parent():
         pass  # non-Linux / no libc: best effort only
 
 
+def build_daemon_binary():
+    """Build the relay daemon if the source is present (no-op when fresh).
+    Returns ``(binary_path or None, error_text)``. The `make` is serialized
+    with an flock: concurrent P2P.create calls from several processes must not
+    race the same output binary. A missing toolchain is an error TEXT, not an
+    exception — callers choose whether to degrade or raise."""
+    import fcntl
+
+    binary = NATIVE_DIR / "relay_daemon"
+    if (NATIVE_DIR / "relay_daemon.cpp").exists():
+        try:
+            with open(NATIVE_DIR / ".build.lock", "w") as lock:
+                fcntl.flock(lock, fcntl.LOCK_EX)
+                build = subprocess.run(
+                    ["make"], cwd=NATIVE_DIR, capture_output=True, text=True
+                )
+            if build.returncode != 0:
+                return None, f"build failed:\n{build.stderr[-500:]}"
+        except OSError as e:  # make not installed, unwritable dir, ...
+            return None, f"native toolchain unavailable: {e!r}"
+    if not binary.exists():
+        return None, "no relay daemon binary or source"
+    return binary, ""
+
+
+def read_daemon_banner(process: subprocess.Popen, timeout: float):
+    """Bounded read of the daemon's two startup lines (it emits exactly two, in
+    one flush — see its main()). Returns ``(line1, line2)`` or None on timeout /
+    early exit; a STALE binary predating the two-line protocol trips the bound
+    instead of hanging the caller forever.
+
+    Reads the RAW fd, not the buffered TextIOWrapper: both lines arrive in one
+    flush, so after a buffered readline the second line sits in the Python-side
+    buffer where select() on the fd would block until timeout."""
+    import select
+    import time
+
+    fd = process.stdout.fileno()
+    buf = b""
+    deadline = time.monotonic() + timeout
+    while buf.count(b"\n") < 2:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return None
+        ready, _, _ = select.select([fd], [], [], remaining)
+        if not ready:
+            return None
+        chunk = os.read(fd, 4096)
+        if not chunk:  # EOF: the child died before finishing its banner
+            return None
+        buf += chunk
+    lines = buf.decode(errors="replace").splitlines()
+    return lines[0].strip(), lines[1].strip()
+
+
 def spawn_native_transport(
     workdir: Optional[str] = None, banner_timeout: float = 30.0
 ) -> Optional[NativeTransportDaemon]:
@@ -85,17 +140,9 @@ def spawn_native_transport(
 
     BLOCKING (the build can take tens of seconds on a slow host): async callers
     must run this in an executor — ``P2P.create`` does."""
-    binary = NATIVE_DIR / "relay_daemon"
-    if (NATIVE_DIR / "relay_daemon.cpp").exists():
-        build = subprocess.run(["make"], cwd=NATIVE_DIR, capture_output=True, text=True)
-        if build.returncode != 0:
-            logger.warning(
-                f"native transport build failed; staying on the asyncio data "
-                f"plane:\n{build.stderr[-500:]}"
-            )
-            return None
-    if not binary.exists():
-        logger.warning("no relay daemon binary; staying on the asyncio data plane")
+    binary, error = build_daemon_binary()
+    if binary is None:
+        logger.warning(f"{error}; staying on the asyncio data plane")
         return None
 
     owns_workdir = workdir is None
@@ -116,20 +163,14 @@ def spawn_native_transport(
             shutil.rmtree(workdir, ignore_errors=True)
         logger.warning(f"{reason}; staying on the asyncio data plane")
 
-    # the daemon prints exactly two startup lines in one flush (see its main());
-    # a bounded select guards against a child that wedges pre-banner
-    import select
-
-    ready, _, _ = select.select([process.stdout], [], [], banner_timeout)
-    if not ready:
-        _give_up(f"daemon produced no banner within {banner_timeout:.0f}s")
+    banner = read_daemon_banner(process, banner_timeout)
+    if banner is None:
+        _give_up(f"daemon produced no complete banner within {banner_timeout:.0f}s")
         return None
-    first = process.stdout.readline().strip()
-    process.stdout.readline()
     try:
-        port = int(first.rsplit(" ", 1)[-1])
+        port = int(banner[0].rsplit(" ", 1)[-1])
     except ValueError:
-        _give_up(f"unexpected daemon banner {first!r}")
+        _give_up(f"unexpected daemon banner {banner[0]!r}")
         return None
     if not os.path.exists(unix_path):
         _give_up("daemon did not create its unix socket")

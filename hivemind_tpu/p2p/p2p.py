@@ -171,10 +171,25 @@ class P2P:
         if native_transport and data_proxy_path is None and data_proxy_port is None:
             from hivemind_tpu.p2p.native_transport import spawn_native_transport
 
-            # the spawn may BUILD the daemon (tens of seconds): keep the loop live
-            self._native_daemon = await asyncio.get_running_loop().run_in_executor(
+            # the spawn may BUILD the daemon (tens of seconds): keep the loop
+            # live. If THIS coroutine is cancelled mid-spawn (wait_for timeout),
+            # the executor thread still finishes — reap its daemon from a done
+            # callback so no orphan child outlives the cancellation.
+            spawn_future = asyncio.get_running_loop().run_in_executor(
                 None, spawn_native_transport
             )
+            try:
+                self._native_daemon = await asyncio.shield(spawn_future)
+            except asyncio.CancelledError:
+                def _reap(fut):
+                    if fut.cancelled() or fut.exception() is not None:
+                        return
+                    daemon = fut.result()
+                    if daemon is not None:
+                        daemon.shutdown()
+
+                spawn_future.add_done_callback(_reap)
+                raise
             if self._native_daemon is not None:
                 data_proxy_path = self._native_daemon.unix_path
                 inbound_data_proxy = True

@@ -259,10 +259,16 @@ def test_adaptive_matchmaking_lead_time_math():
     mm._others_observed = False
 
     assert mm.suggested_lead_time() == 1.0
-    mm._record_round_outcome(None)  # solo swarm: nobody to match with, no backoff
-    assert mm.suggested_lead_time() == 1.0  # advisor r4: solo expiry must not ratchet
-    mm._others_observed = True  # peers are around now: expiry means contention
-    mm._record_round_outcome(None)  # window expired
+    # a peer that starts before its swarm ratchets while alone (harmless —
+    # nobody to match with), but FIRST CONTACT discards the solo-era backoff so
+    # the first real group forms at the base lead time (advisor r4)
+    for _ in range(6):
+        mm._record_round_outcome(None)
+    assert mm._lead_backoff > 1.0
+    mm._note_others_observed()
+    assert mm._lead_backoff == 1.0 and mm.suggested_lead_time() == 1.0
+    mm._note_others_observed()  # later observations never reset again
+    mm._record_round_outcome(None)  # window expired under contention
     mm._record_round_outcome(None)
     assert mm.suggested_lead_time() == 4.0  # 1.0 * 2 * 2
     for _ in range(10):
