@@ -43,6 +43,11 @@ def main():
                              "serve (default: HBM-budgeted from the start, or all "
                              "when the platform reports no memory limit)")
     parser.add_argument("--llama_uid_prefix", default="llama.")
+    parser.add_argument("--mesh_devices", type=int, default=0,
+                        help="serve each block MESH-SHARDED over this many local "
+                             "devices (params + KV caches as NamedSharding arrays; "
+                             "0 = single-device serving). The HBM plan pools the "
+                             "mesh's budget, so blocks one chip cannot hold fit")
     parser.add_argument("--weight_quantization", choices=["int8"], default=None,
                         help="serve blocks int8 weight-only via the blockwise "
                              "codec (4x less resident HBM; inference-only)")
@@ -84,6 +89,11 @@ def main():
         server = _serve_llama_checkpoint(args)
         _run_forever(server)
         return
+    if args.mesh_devices:
+        raise SystemExit(
+            "--mesh_devices is only supported with --llama_checkpoint serving; "
+            "the registry-expert path would silently ignore it"
+        )
 
     from hivemind_tpu.dht import DHT
 
@@ -119,6 +129,21 @@ def _serve_llama_checkpoint(args) -> Server:
         plan_block_capacity,
     )
 
+    mesh = None
+    if args.mesh_devices:
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        if args.mesh_devices < 1:
+            raise ValueError(f"--mesh_devices must be >= 1, got {args.mesh_devices}")
+        devices = jax.local_devices()[: args.mesh_devices]
+        if len(devices) < args.mesh_devices:
+            raise RuntimeError(
+                f"--mesh_devices {args.mesh_devices} but only {len(devices)} local devices"
+            )
+        mesh = Mesh(np.array(devices).reshape(len(devices)), ("tp",))
+
     config = LlamaCheckpointConfig.load(args.llama_checkpoint)
     if args.llama_layers:
         start, _, stop = args.llama_layers.partition(":")
@@ -130,22 +155,32 @@ def _serve_llama_checkpoint(args) -> Server:
             # measure one real block, then plan with KV-cache headroom
             probe, _ = load_llama_blocks(
                 args.llama_checkpoint, layers=[0], uid_prefix="_probe.",
-                weight_quantization=args.weight_quantization,
+                weight_quantization=args.weight_quantization, mesh=mesh,
             )
-            block_bytes = next(iter(probe.values())).param_bytes()
-            del probe  # release the probe block before the real load fills the plan
+            probe_backend = next(iter(probe.values()))
+            # mesh serving: plan from the MEASURED per-device residency, not an
+            # assumed 1/mesh fraction — kernels whose last dim does not divide
+            # the mesh REPLICATE (leaf_spec), and only the probe knows how much
+            block_bytes = (
+                probe_backend.param_bytes_per_device() if mesh is not None
+                else probe_backend.param_bytes()
+            )
+            del probe, probe_backend  # release before the real load fills the plan
             fit = plan_block_capacity(
                 block_bytes,
                 hbm_bytes=hbm,
                 decode_sessions=args.decode_sessions_budget,
+                # conservative: budget FULL per-session caches on every chip
+                # (cache sharding is also divisibility-dependent)
                 cache_bytes_per_session_block=decode_cache_bytes(
                     config, batch=1, max_len=args.decode_max_len
                 ),
             )
             layers = range(min(fit, config.num_hidden_layers))
             logger.info(
-                f"HBM plan: {block_bytes / 1e6:.0f} MB/block, "
-                f"{hbm / 1e9:.1f} GB chip → serving {len(layers)} of "
+                f"HBM plan: {block_bytes / 1e6:.0f} MB/block resident per chip "
+                f"({'mesh of ' + str(args.mesh_devices) if mesh is not None else 'single device'}), "
+                f"{hbm / 1e9:.1f} GB/chip → serving {len(layers)} of "
                 f"{config.num_hidden_layers} layers"
             )
     backends, _config = load_llama_blocks(
@@ -154,6 +189,7 @@ def _serve_llama_checkpoint(args) -> Server:
         uid_prefix=args.llama_uid_prefix,
         weight_quantization=args.weight_quantization,
         max_batch_size=args.max_batch_size,
+        mesh=mesh,
     )
     dht = DHT(initial_peers=args.initial_peers, start=True,
               max_connections=args.max_connections)
