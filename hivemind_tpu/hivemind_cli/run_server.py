@@ -46,8 +46,9 @@ def main():
     parser.add_argument("--mesh_devices", type=int, default=0,
                         help="serve each block MESH-SHARDED over this many local "
                              "devices (params + KV caches as NamedSharding arrays; "
-                             "0 = single-device serving). The HBM plan pools the "
-                             "mesh's budget, so blocks one chip cannot hold fit")
+                             "0 = single-device serving). The HBM plan uses the "
+                             "probe block's MEASURED per-device residency, so "
+                             "blocks one chip cannot hold fit when they shard")
     parser.add_argument("--weight_quantization", choices=["int8"], default=None,
                         help="serve blocks int8 weight-only via the blockwise "
                              "codec (4x less resident HBM; inference-only)")

@@ -250,12 +250,16 @@ def plan_block_capacity(
     """How many blocks fit the serving unit:
     ``(HBM*devices*(1-reserve) - sessions*cache) / block``.
 
-    ``mesh_devices`` > 1 plans a MESH-sharded server (``MeshModuleBackend``):
-    ``hbm_bytes`` stays the PER-CHIP budget and the pooled budget scales with the
-    mesh — the regime where one chip cannot hold a single block but the slice
-    can. Sharded residency is what makes the pooling real: params and KV caches
-    divide across the mesh axis, so per-chip residency is ``1/mesh_devices`` of
-    each block (see MeshModuleBackend.param_bytes_per_device).
+    ``mesh_devices`` > 1 plans a MESH-sharded server (``MeshModuleBackend``)
+    from GLOBAL block bytes only — e.g. pre-load planning via
+    ``predict_block_param_bytes`` — by assuming ideal ``1/mesh_devices``
+    residency: ``hbm_bytes`` stays the PER-CHIP budget and the pooled budget
+    scales with the mesh. When a probe block EXISTS, prefer passing its
+    ``param_bytes_per_device()`` as ``block_bytes`` with the default
+    ``mesh_devices=1`` instead (run_server does): measured residency also
+    counts kernels that REPLICATE because their dims do not divide the mesh.
+    Never combine per-device bytes with ``mesh_devices`` > 1 — that multiplies
+    the budget while the cost is already divided, overcommitting ~N².
 
     ``reserve_fraction`` keeps headroom for activations, the transient dense
     weights of int8 serving, and XLA workspace. Returns at least 0.

@@ -18,6 +18,7 @@ _WORKER = r"""
 import os, sys, threading, time
 proc_id = int(sys.argv[1])
 port = sys.argv[2]
+dpu_mode = len(sys.argv) > 3 and sys.argv[3] == "dpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -65,7 +66,8 @@ else:
 slice_opt = SliceOptimizer(
     mesh=mesh, params=params, optimizer=opt, dht_factory=dht_factory,
     run_id="slice_full_opt", target_batch_size=TARGET, batch_size_per_step=16,
-    load_state_timeout=30.0, **(common_av if proc_id == 0 else {}),
+    load_state_timeout=30.0, delay_grad_averaging=dpu_mode,
+    **(common_av if proc_id == 0 else {}),
 )
 if proc_id != 0:
     # the structural claim: followers own NO networking objects at all
@@ -96,10 +98,26 @@ if proc_id == 0:
     host_thread = threading.Thread(target=host_loop, daemon=True)
     host_thread.start()
 deadline = time.monotonic() + 240
+steps_while_pending = 0
 while slice_opt.local_epoch < EPOCHS and time.monotonic() < deadline:
+    # count BEFORE stepping: only a step ENTERED with a round already in flight
+    # proves overlap (the launching step itself always leaves _pending set)
+    entered_pending = slice_opt._pending is not None
     slice_opt.step(g_slice, batch_size=16)
+    if entered_pending:
+        steps_while_pending += 1
     time.sleep(0.25)
 assert slice_opt.local_epoch >= EPOCHS, f"[{proc_id}] stuck at epoch {slice_opt.local_epoch}"
+if dpu_mode:
+    # drain the last in-flight round so every counted epoch's update landed
+    drain = time.monotonic() + 90
+    while slice_opt._pending is not None and time.monotonic() < drain:
+        slice_opt.step(None)
+        time.sleep(0.25)
+    assert slice_opt._pending is None, f"[{proc_id}] pending round never adopted"
+    # the overlap is real on BOTH processes: training steps ran while a swarm
+    # round was in flight (the synchronous mode blocks inside the round)
+    assert steps_while_pending >= 1, f"[{proc_id}] no overlap observed"
 epochs_done = slice_opt.local_epoch
 
 # weighted-by-samples group averaging (reference semantics — with the r5 grace
@@ -185,7 +203,7 @@ print(f"SLICE_OPT_OK_{proc_id}", flush=True)
 """
 
 
-def test_full_optimizer_on_two_process_slice(tmp_path):
+def _run_two_process_slice_workers(tmp_path, mode: str = "sync"):
     with socket.socket() as probe:
         probe.bind(("127.0.0.1", 0))
         port = str(probe.getsockname()[1])
@@ -195,13 +213,16 @@ def test_full_optimizer_on_two_process_slice(tmp_path):
         [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
         + [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep) if p]
     ))
-    workers = [
+    return [
         subprocess.Popen(
-            [sys.executable, str(script), str(i), port],
+            [sys.executable, str(script), str(i), port, mode],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
         )
         for i in range(2)
     ]
+
+
+def _assert_two_process_workers_ok(workers):
     try:
         for i, worker in enumerate(workers):
             out, _ = worker.communicate(timeout=540)
@@ -213,6 +234,20 @@ def test_full_optimizer_on_two_process_slice(tmp_path):
         for worker in workers:
             if worker.poll() is None:
                 worker.kill()
+
+
+def test_full_optimizer_on_two_process_slice(tmp_path):
+    _assert_two_process_workers_ok(_run_two_process_slice_workers(tmp_path, "sync"))
+
+
+def test_full_optimizer_on_two_process_slice_dpu(tmp_path):
+    """The DELAYED (DPU) path under real multihost collectives: the same
+    two-process worker with ``delay_grad_averaging=True`` — the launch/adopt
+    lifecycle, the 8-slot decision broadcast, and the catch-up interplay must
+    hold with a genuinely separate follower process (the single-process DPU
+    tests cannot catch a cross-process collective-ordering divergence). Both
+    workers additionally assert steps ran while a round was in flight."""
+    _assert_two_process_workers_ok(_run_two_process_slice_workers(tmp_path, "dpu"))
 
 
 def test_slice_collaborative_example_single_process():
@@ -469,8 +504,11 @@ def test_delay_grad_averaging_overlaps_training():
     try:
         deadline = time.monotonic() + 240
         while slice_opt.local_epoch < EPOCHS and time.monotonic() < deadline:
+            # count BEFORE stepping: only a step ENTERED with a round already in
+            # flight proves overlap (the launch itself always sets _pending)
+            entered_pending = slice_opt._pending is not None
             slice_opt.step(g_slice, batch_size=8)
-            if slice_opt._pending is not None:
+            if entered_pending:
                 steps_while_pending += 1
             time.sleep(0.02)
         assert slice_opt.local_epoch >= EPOCHS, f"stuck at {slice_opt.local_epoch}"
