@@ -597,6 +597,107 @@ def test_broadcast_thinning_preserves_lockstep_and_transitions():
         opt.shutdown()
 
 
+def test_load_state_dict_discards_pending_delayed_round():
+    """A checkpoint restore during an in-flight delayed round must DISCARD the
+    round: its staged gradients were computed against the replaced state, and
+    landing them on the restored params would silently corrupt the checkpoint
+    (review finding on the r5 DPU work). The restore wins; the next steps train
+    from exactly the checkpoint."""
+    import time
+
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from hivemind_tpu.dht import DHT
+    from hivemind_tpu.optim import SliceOptimizer
+    from hivemind_tpu.optim.progress_tracker import ProgressTracker
+
+    mesh = Mesh(np.array(jax.devices()).reshape(len(jax.devices())), ("dp",))
+    sharding = NamedSharding(mesh, P("dp"))
+    TARGET = 16
+    boot = DHT(start=True)
+    opt = SliceOptimizer(
+        mesh=mesh, params={"w": jax.device_put(np.zeros((8, 4), np.float32), sharding)},
+        optimizer=optax.sgd(0.1), dht_factory=lambda: boot,
+        run_id="restore_vs_pending", target_batch_size=TARGET, batch_size_per_step=8,
+        delay_grad_averaging=True, matchmaking_time=1.0, averaging_timeout=30.0,
+    )
+    ghost_dht = DHT(initial_peers=[str(m) for m in boot.get_visible_maddrs()], start=True)
+    ghost = ProgressTracker(ghost_dht, "restore_vs_pending", TARGET)
+    try:
+        checkpoint = opt.state_dict()  # the all-zeros epoch-0 state
+        ghost.report_local_progress(0, TARGET)  # num_peers=2: delayed rounds engage
+        g = {"w": jax.device_put(np.ones((8, 4), np.float32), sharding)}
+        deadline = time.monotonic() + 60
+        while opt._pending is None and time.monotonic() < deadline:
+            opt.step(g, batch_size=8)
+            time.sleep(0.1)
+        assert opt._pending is not None, "no delayed round ever launched"
+
+        opt.load_state_dict(checkpoint)
+        assert opt._pending is None, "restore left the stale round pending"
+        assert opt.local_epoch == checkpoint["epoch"]
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(opt.params["w"])), 0.0, atol=1e-6
+        )
+        # the next step must NOT adopt ghost-round leftovers onto the restore
+        opt.step(None)
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(opt.params["w"])), 0.0, atol=1e-6
+        )
+    finally:
+        ghost.shutdown()
+        ghost_dht.shutdown()
+        opt.shutdown()
+
+
+def test_thinned_steps_defer_network_errors_to_next_broadcast():
+    """An error in process 0's networking DURING a skipped (collective-free) step
+    must not raise there — that would desync the skip countdown across processes
+    — but at the NEXT broadcast step, via the error-flagged decision vector."""
+    import jax
+    import numpy as np
+    import optax
+    import pytest
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from hivemind_tpu.dht import DHT
+    from hivemind_tpu.optim import SliceOptimizer
+
+    mesh = Mesh(np.array(jax.devices()).reshape(len(jax.devices())), ("dp",))
+    sharding = NamedSharding(mesh, P("dp"))
+    opt = SliceOptimizer(
+        mesh=mesh, params={"w": jax.device_put(np.zeros((8, 4), np.float32), sharding)},
+        optimizer=optax.sgd(0.1), dht_factory=lambda: DHT(start=True),
+        run_id="thinned_defer", target_batch_size=1 << 30, batch_size_per_step=1,
+        max_broadcast_skip=4,
+    )
+    g = {"w": jax.device_put(np.ones((8, 4), np.float32), sharding)}
+    try:
+        deadline_steps = 200
+        while opt._skip_remaining == 0 and deadline_steps:
+            opt.step(g, batch_size=1)
+            deadline_steps -= 1
+        assert opt._skip_remaining > 0, "thinning never engaged"
+
+        def boom(*args, **kwargs):
+            raise OSError("injected during a skipped step")
+
+        opt.tracker.report_local_progress = boom
+        skipped_without_raise = 0
+        with pytest.raises(OSError, match="injected during a skipped step"):
+            for _ in range(opt._skip_remaining + 1):
+                before = opt._skip_remaining
+                opt.step(g, batch_size=1)
+                if before > 0:
+                    skipped_without_raise += 1  # skipped steps swallow + defer
+        assert skipped_without_raise >= 1
+    finally:
+        opt.shutdown()
+
+
 def test_network_process_failure_raises_in_lockstep_not_hangs():
     """Advisor r4 medium finding: if process 0's networking raises inside step()'s
     decision phase (DHT store failure, tracker shutdown), it must STILL broadcast
