@@ -418,6 +418,23 @@ def compact_result(result: dict, max_chars: int = 1500) -> str:
     return line
 
 
+def telemetry_section(averaging=None) -> dict:
+    """The telemetry snapshot embedded in every BENCH artifact (ISSUE 2): the
+    bench process's own registry plus the averaging swarm's snapshot (shipped
+    through the subprocess's JSON extra), so round artifacts carry a per-phase
+    breakdown — five rounds of BENCH carried none (VERDICT r5)."""
+    try:
+        from hivemind_tpu.telemetry import build_peer_snapshot
+
+        section: dict = {"bench_process": build_peer_snapshot()}
+    except Exception as e:  # the artifact must survive a broken local install
+        section = {"error": repr(e)[:200]}
+    swarm = ((averaging or {}).get("extra") or {}).get("telemetry")
+    if swarm:
+        section["averaging_swarm"] = swarm
+    return section
+
+
 def emit(result: dict, out=None, err=None) -> None:
     """Full diagnostics (probe log, controls, errors) go to stderr; stdout's final
     line is the compact metric-first JSON the driver records."""
@@ -454,11 +471,17 @@ def main() -> None:
 
     result.setdefault("extra", {})
     result["extra"]["averaging_gbps_per_peer"] = (averaging or {}).get("value")
-    result["extra"]["averaging_extra"] = (averaging or {}).get("extra")
+    # the swarm telemetry snapshot lands ONCE, in result["telemetry"] below —
+    # strip it from the copied extra so the artifact does not carry it twice
+    averaging_extra = (averaging or {}).get("extra")
+    if isinstance(averaging_extra, dict):
+        averaging_extra = {k: v for k, v in averaging_extra.items() if k != "telemetry"}
+    result["extra"]["averaging_extra"] = averaging_extra
     # attributability: the same-config controls bracket the averaging run, so a
     # co-tenancy swing shows up as a control swing right next to the number
     result["extra"]["host_control"] = {"at_start": control_start, "at_end": control_end}
     result["tpu_probe_log"] = probe_log
+    result["telemetry"] = telemetry_section(averaging)
     if diagnostics:
         result["tpu_measure_errors"] = diagnostics
     emit(result)

@@ -38,6 +38,7 @@ def main():
     from hivemind_tpu.averaging import DecentralizedAverager
     from hivemind_tpu.compression import CompressionType, get_codec
     from hivemind_tpu.dht import DHT
+    from hivemind_tpu.telemetry import REGISTRY
 
     first = DHT(start=True)
     maddrs = [str(m) for m in first.get_visible_maddrs()]
@@ -80,6 +81,10 @@ def main():
             "peers": args.num_peers, "rounds": args.num_rounds,
             "params": args.num_params, "success_rate": successes / max(attempts, 1),
             "seconds_per_round": round(elapsed / args.num_rounds, 3),
+            # the registry saw every matchmaking/all-reduce/DHT event of this
+            # swarm: embed it so BENCH artifacts carry the per-phase breakdown
+            # (VERDICT r5: five rounds of artifacts had none)
+            "telemetry": REGISTRY.snapshot(),
         },
     }))
     for averager in averagers:

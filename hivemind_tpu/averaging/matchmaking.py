@@ -16,6 +16,7 @@ import asyncio
 import contextlib
 import os
 import random
+import time
 from typing import AsyncIterator, Dict, Optional, Tuple
 
 from hivemind_tpu.averaging.group_info import GroupInfo
@@ -27,6 +28,22 @@ from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.timed_storage import DHTExpiration, get_dht_time
 
 logger = get_logger(__name__)
+
+# layer-3 telemetry (docs/observability.md): how long group formation takes and
+# how often it fails — the first place to look when a training round stalls
+from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
+
+_MATCHMAKING_WAIT = _TELEMETRY.histogram(
+    "hivemind_averaging_matchmaking_seconds",
+    "declare-to-outcome wall time of one look_for_group",
+    ("outcome",),
+)
+_MATCHMAKING_ROUNDS = _TELEMETRY.counter(
+    "hivemind_averaging_matchmaking_rounds_total", "look_for_group attempts", ("outcome",)
+)
+_GROUP_SIZE = _TELEMETRY.gauge(
+    "hivemind_averaging_group_size", "size of the most recently assembled group"
+)
 
 
 class MatchmakingException(Exception):
@@ -155,13 +172,24 @@ class Matchmaking:
                     )
                 declare_task = asyncio.create_task(self._declare_periodically(declared_key))
             search_started = get_dht_time()
+            wait_started = time.perf_counter()  # the metric must survive clock steps
+            group = None
+            outcome = "error"  # overwritten on a normal return; errors stay visible
             try:
                 group = await self._search_until_deadline()
+                outcome = "assembled" if group is not None else "expired"
                 self._record_round_outcome(
                     get_dht_time() - search_started if group is not None else None
                 )
                 return group
+            except asyncio.CancelledError:
+                outcome = "cancelled"  # control.cancel / shutdown: not an error
+                raise
             finally:
+                _MATCHMAKING_WAIT.observe(time.perf_counter() - wait_started, outcome=outcome)
+                _MATCHMAKING_ROUNDS.inc(outcome=outcome)
+                if group is not None:
+                    _GROUP_SIZE.set(len(group.peer_ids))
                 self.looking_for_group = False
                 self.current_leader = None
                 if declare_task is not None:

@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 import os
 import random
+import time
 from collections import defaultdict
 from dataclasses import dataclass, field
 from typing import (
@@ -43,6 +44,15 @@ from hivemind_tpu.utils.timed_storage import (
 logger = get_logger(__name__)
 
 DEFAULT_NUM_WORKERS = int(os.getenv("HIVEMIND_TPU_DHT_NUM_WORKERS", "4"))
+
+# layer-2 telemetry (docs/observability.md): whole-operation (beam-search level)
+# store/get latency as seen by DHT users — distinct from the per-RPC timings in
+# dht/protocol.py, which measure single peer round-trips
+from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
+
+_DHT_OP_LATENCY = _TELEMETRY.histogram(
+    "hivemind_dht_operation_latency_seconds", "store_many/get_many wall time", ("op",)
+)
 
 
 class Blacklist:
@@ -314,6 +324,7 @@ class DHTNode:
         """Serialize values, find ``num_replicas`` nearest nodes per key (possibly
         including self), and store with per-subkey records + validator signatures
         (reference node.py:351-503)."""
+        started = time.perf_counter()
         if isinstance(expiration_time, (int, float)):
             expiration_time = [expiration_time] * len(keys)
         if subkeys is None:
@@ -387,6 +398,7 @@ class DHTNode:
                 output.setdefault(result_key, False)
 
         await asyncio.gather(*(_store_one_key(key_id) for key_id in prepared))
+        _DHT_OP_LATENCY.observe(time.perf_counter() - started, op="store")
         return output
 
     # ------------------------------------------------------------------ get
@@ -421,6 +433,7 @@ class DHTNode:
         found (sufficient_expiration_time defaults to 'valid now'). With
         ``return_futures``, each value is a future resolved when that key finishes
         (reference node.py:534-678)."""
+        started = time.perf_counter()
         key_ids = list(key_ids)
         if sufficient_expiration_time is None:
             sufficient_expiration_time = get_dht_time()
@@ -489,8 +502,18 @@ class DHTNode:
             future = reused.get(key_id, futures[key_id])
             output[key_id] = future if return_futures else None
         if return_futures:
+            # the op finishes when the LAST future resolves — observe from a
+            # done-callback so futures-mode gets (the long beam searches) are
+            # not invisible to the latency metric
+            watcher = asyncio.gather(
+                *(reused.get(kid, futures[kid]) for kid in key_ids), return_exceptions=True
+            )
+            watcher.add_done_callback(
+                lambda _w: _DHT_OP_LATENCY.observe(time.perf_counter() - started, op="get")
+            )
             return output
         gathered = await asyncio.gather(*(reused.get(kid, futures[kid]) for kid in key_ids))
+        _DHT_OP_LATENCY.observe(time.perf_counter() - started, op="get")
         return dict(zip(key_ids, gathered))
 
     def _finalize_get(

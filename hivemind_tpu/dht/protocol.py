@@ -4,6 +4,7 @@ handoff (capability parity: reference hivemind/dht/protocol.py:25-430)."""
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Collection, Dict, List, Optional, Sequence, Tuple, Union
 
 from hivemind_tpu.dht.routing import (
@@ -27,6 +28,20 @@ from hivemind_tpu.utils.timed_storage import (
 )
 
 logger = get_logger(__name__)
+
+# layer-2 telemetry (docs/observability.md): per-RPC outbound latency/failures
+# and the live routing-table size of this node
+from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
+
+_DHT_RPC_LATENCY = _TELEMETRY.histogram(
+    "hivemind_dht_rpc_latency_seconds", "outbound DHT RPC wall time", ("rpc",)
+)
+_DHT_RPC_FAILURES = _TELEMETRY.counter(
+    "hivemind_dht_rpc_failures_total", "outbound DHT RPCs that returned no reply", ("rpc",)
+)
+_DHT_ROUTING_TABLE_SIZE = _TELEMETRY.gauge(
+    "hivemind_dht_routing_table_size", "peers currently in this node's routing table"
+)
 
 # sentinel subkey meaning "this value is not a dictionary entry"
 IS_REGULAR_VALUE = b""
@@ -97,6 +112,7 @@ class DHTProtocol(ServicerBase):
         """Ping a peer; registers it in the routing table. Returns its node id, or
         None if unreachable. ``validate``: also check clock skew (reference
         protocol.py:97-162)."""
+        started = time.perf_counter()
         try:
             stub = self.get_stub(self.p2p, peer)
             response = await stub.rpc_ping(
@@ -104,8 +120,10 @@ class DHTProtocol(ServicerBase):
                 timeout=self.wait_timeout,
             )
         except Exception as e:
+            _DHT_RPC_FAILURES.inc(rpc="ping")
             logger.debug(f"ping to {peer} failed: {e!r}")
             return None
+        _DHT_RPC_LATENCY.observe(time.perf_counter() - started, rpc="ping")
         peer_node_id = DHTID.from_bytes(response.peer.node_id)
         self.update_routing_table(peer_node_id, peer, response.peer.maddrs, responded=True)
         if validate:
@@ -161,6 +179,7 @@ class DHTProtocol(ServicerBase):
                 flat_values.append(value)
                 flat_expirations.append(expiration)
                 flat_in_cache.append(cached)
+        started = time.perf_counter()
         try:
             stub = self.get_stub(self.p2p, peer)
             response = await stub.rpc_store(
@@ -174,12 +193,14 @@ class DHTProtocol(ServicerBase):
                 ),
                 timeout=self.wait_timeout,
             )
+            _DHT_RPC_LATENCY.observe(time.perf_counter() - started, rpc="store")
             if response.peer.node_id:
                 self.update_routing_table(
                     DHTID.from_bytes(response.peer.node_id), peer, response.peer.maddrs, responded=True
                 )
             return list(response.store_ok)
         except Exception as e:
+            _DHT_RPC_FAILURES.inc(rpc="store")
             logger.debug(f"store to {peer} failed: {e!r}")
             return None
 
@@ -225,12 +246,14 @@ class DHTProtocol(ServicerBase):
         """Ask a peer for values and/or its nearest neighbors for each key
         (reference protocol.py:271-331)."""
         keys = list(keys)
+        started = time.perf_counter()
         try:
             stub = self.get_stub(self.p2p, peer)
             response = await stub.rpc_find(
                 dht_pb2.FindRequest(keys=[k.to_bytes() for k in keys], peer=self._make_node_info()),
                 timeout=self.wait_timeout,
             )
+            _DHT_RPC_LATENCY.observe(time.perf_counter() - started, rpc="find")
             if response.peer.node_id:
                 self.update_routing_table(
                     DHTID.from_bytes(response.peer.node_id), peer, response.peer.maddrs, responded=True
@@ -258,6 +281,7 @@ class DHTProtocol(ServicerBase):
                     output[key_id] = None, nearest
             return output
         except Exception as e:
+            _DHT_RPC_FAILURES.inc(rpc="find")
             logger.debug(f"find to {peer} failed: {e!r}")
             return None
 
@@ -314,9 +338,11 @@ class DHTProtocol(ServicerBase):
                 continue
         if not responded:
             self.routing_table.remove_node(node_id)
+            _DHT_ROUTING_TABLE_SIZE.set(len(self.routing_table))
             return
         is_new = node_id not in self.routing_table
         ping_candidate = self.routing_table.add_or_update_node(node_id, PeerInfo(peer_id, tuple(maddrs)))
+        _DHT_ROUTING_TABLE_SIZE.set(len(self.routing_table))
         if ping_candidate is not None:
             # bucket full: ping the stalest entry; evict it if dead (Kademlia §4.1)
             task = asyncio.create_task(self._check_stale_node(*ping_candidate))

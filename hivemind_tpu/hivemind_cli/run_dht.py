@@ -24,6 +24,17 @@ def main():
     parser.add_argument("--max_connections", type=int, default=0,
                         help="connection-manager high water (0 = unlimited): idle "
                              "LRU connections close past it, bounding fds at scale")
+    parser.add_argument("--metrics-port", "--metrics_port", type=int, default=None,
+                        dest="metrics_port",
+                        help="serve Prometheus text exposition at "
+                             "http://<metrics_host>:PORT/metrics (0 = auto-pick)")
+    parser.add_argument("--metrics_host", default="127.0.0.1",
+                        help="bind host of the metrics endpoint (0.0.0.0 for "
+                             "remote scrapers)")
+    parser.add_argument("--telemetry_key", default=None,
+                        help="publish this peer's telemetry snapshot to the DHT "
+                             "under this key every --refresh_period seconds "
+                             "(see docs/observability.md)")
     from hivemind_tpu.utils.platform import add_platform_arg, apply_platform
 
     add_platform_arg(parser)
@@ -43,6 +54,16 @@ def main():
         logger.info(f"listening: {maddr}")
     logger.info(f"to join this swarm: --initial_peers {dht.get_visible_maddrs()[0]}")
 
+    exporter = publisher = None
+    if args.metrics_port is not None:
+        from hivemind_tpu.telemetry import MetricsExporter
+
+        exporter = MetricsExporter(port=args.metrics_port, host=args.metrics_host)
+    if args.telemetry_key:
+        from hivemind_tpu.telemetry import TelemetryPublisher
+
+        publisher = TelemetryPublisher(dht, args.telemetry_key, interval=args.refresh_period)
+
     try:
         while True:
             time.sleep(args.refresh_period)
@@ -59,6 +80,10 @@ def main():
             )
     except KeyboardInterrupt:
         logger.info("shutting down")
+        if publisher is not None:
+            publisher.shutdown()
+        if exporter is not None:
+            exporter.shutdown()
         dht.shutdown()
 
 

@@ -61,6 +61,17 @@ def main():
                              "(0 = unlimited; bounds fds at swarm scale)")
     parser.add_argument("--increase_file_limit", action="store_true",
                         help="raise RLIMIT_NOFILE for many concurrent connections")
+    parser.add_argument("--metrics-port", "--metrics_port", type=int, default=None,
+                        dest="metrics_port",
+                        help="serve Prometheus text exposition at "
+                             "http://<metrics_host>:PORT/metrics (0 = auto-pick)")
+    parser.add_argument("--metrics_host", default="127.0.0.1",
+                        help="bind host of the metrics endpoint (0.0.0.0 for "
+                             "remote scrapers)")
+    parser.add_argument("--telemetry_key", default=None,
+                        help="publish this server's telemetry snapshot to the DHT "
+                             "under this key every --telemetry_interval seconds")
+    parser.add_argument("--telemetry_interval", type=float, default=30.0)
     from hivemind_tpu.utils.platform import add_platform_arg, apply_platform
 
     add_platform_arg(parser)
@@ -88,7 +99,7 @@ def main():
 
     if args.llama_checkpoint:
         server = _serve_llama_checkpoint(args)
-        _run_forever(server)
+        _run_forever(server, _start_telemetry(args, server.dht))
         return
     if args.mesh_devices:
         raise SystemExit(
@@ -115,7 +126,7 @@ def main():
         optim_factory=lambda: optax.adam(args.learning_rate),
         start=True,
     )
-    _run_forever(server)
+    _run_forever(server, _start_telemetry(args, dht))
 
 
 def _serve_llama_checkpoint(args) -> Server:
@@ -204,7 +215,24 @@ def _serve_llama_checkpoint(args) -> Server:
     return server
 
 
-def _run_forever(server: Server) -> None:
+def _start_telemetry(args, dht):
+    """Optional metrics endpoint + DHT snapshot publisher (docs/observability.md);
+    returns the components to shut down, or an empty tuple."""
+    components = []
+    if args.metrics_port is not None:
+        from hivemind_tpu.telemetry import MetricsExporter
+
+        components.append(MetricsExporter(port=args.metrics_port, host=args.metrics_host))
+    if args.telemetry_key:
+        from hivemind_tpu.telemetry import TelemetryPublisher
+
+        components.append(
+            TelemetryPublisher(dht, args.telemetry_key, interval=args.telemetry_interval)
+        )
+    return tuple(components)
+
+
+def _run_forever(server: Server, telemetry=()) -> None:
     for maddr in server.dht.get_visible_maddrs():
         logger.info(f"listening: {maddr}")
     logger.info(f"serving {len(server.backends)} experts: {sorted(server.backends)[:8]}…")
@@ -213,6 +241,8 @@ def _run_forever(server: Server) -> None:
             time.sleep(60)
     except KeyboardInterrupt:
         logger.info("shutting down")
+        for component in telemetry:
+            component.shutdown()
         server.shutdown()
         server.dht.shutdown()
 

@@ -6,10 +6,8 @@ dispatch never blocks the event loop)."""
 from __future__ import annotations
 
 import asyncio
-import contextlib
 import time
-from collections import defaultdict
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Optional, Sequence, Tuple
 
 from hivemind_tpu.moe.server.task_pool import TaskPool
 from hivemind_tpu.utils.asyncio_utils import run_in_executor
@@ -17,14 +15,53 @@ from hivemind_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
+# layer-5 telemetry (docs/observability.md): per-pool throughput, batch latency
+# and queue depth — the registry replaces the old private per-Runtime _stats
+# dict, so one scrape sees the same numbers the periodic log line reports
+from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
+
+_BATCHES = _TELEMETRY.counter(
+    "hivemind_moe_batches_total", "batches processed by the runtime", ("pool",)
+)
+_SAMPLES = _TELEMETRY.counter(
+    "hivemind_moe_samples_total", "samples processed by the runtime", ("pool",)
+)
+_BATCH_FAILURES = _TELEMETRY.counter(
+    "hivemind_moe_batch_failures_total", "batches whose processing function raised", ("pool",)
+)
+_BATCH_LATENCY = _TELEMETRY.histogram(
+    "hivemind_moe_batch_latency_seconds", "device time of one batch", ("pool",)
+)
+_QUEUE_DEPTH = _TELEMETRY.gauge(
+    "hivemind_moe_pool_queue_depth", "tasks waiting in a pool after the last drain", ("pool",)
+)
+
 
 class Runtime:
     def __init__(self, pools: Sequence[TaskPool], stats_report_interval: Optional[float] = 60.0):
         self.pools = list(pools)
         self.stats_report_interval = stats_report_interval
         self._task: Optional[asyncio.Task] = None
-        self._stats: Dict[str, List[float]] = defaultdict(lambda: [0.0, 0.0, 0.0])  # batches, samples, seconds
         self._last_report = time.perf_counter()
+        # cached metric children: pool names are stable for the Runtime's lifetime
+        self._children = {
+            pool.name: (
+                _BATCHES.labels(pool.name),
+                _SAMPLES.labels(pool.name),
+                _BATCH_LATENCY.labels(pool.name),
+                _QUEUE_DEPTH.labels(pool.name),
+            )
+            for pool in self.pools
+        }
+        # cumulative (batches, samples, seconds) at the last report, per pool —
+        # the registry holds process-lifetime totals; the log line shows deltas.
+        # Seeded from the CURRENT totals: the counters are process-global, so a
+        # second Runtime reusing a pool name must not replay its predecessor's
+        # work as one giant first interval.
+        self._reported: Dict[str, Tuple[float, float, float]] = {
+            name: (batches.value, samples.value, latency.sum)
+            for name, (batches, samples, latency, _depth) in self._children.items()
+        }
 
     def start(self) -> None:
         self._task = asyncio.create_task(self._run())
@@ -42,6 +79,8 @@ class Runtime:
                 await asyncio.sleep(0.001)
                 continue
             batch = pool.pop_batch()
+            batches_c, samples_c, latency_h, depth_g = self._children[pool.name]
+            depth_g.set(pool.queue_size)
             if not batch:
                 continue
             start = time.perf_counter()
@@ -49,31 +88,36 @@ class Runtime:
                 await run_in_executor(pool.process_batch, batch)
             except Exception as e:
                 logger.warning(f"pool {pool.name}: batch failed with {e!r}")
+                _BATCH_FAILURES.inc(pool=pool.name)
                 pool.fail_batch(batch, e)
                 continue
             elapsed = time.perf_counter() - start
-            stats = self._stats[pool.name]
-            stats[0] += 1
-            stats[1] += sum(t.batch_size for t in batch)
-            stats[2] += elapsed
+            batches_c.inc()
+            samples_c.inc(sum(t.batch_size for t in batch))
+            latency_h.observe(elapsed)
             self._maybe_report_stats()
 
     def _maybe_report_stats(self) -> None:
         """StatsReporter parity (reference runtime.py:161-199): periodic per-pool
-        batch size / throughput logging."""
+        batch size / throughput logging, computed as deltas over the registry's
+        cumulative counters."""
         if self.stats_report_interval is None:
             return
         now = time.perf_counter()
         if now - self._last_report < self.stats_report_interval:
             return
         self._last_report = now
-        for name, (batches, samples, seconds) in sorted(self._stats.items()):
+        for name in sorted(self._children):
+            batches_c, samples_c, latency_h, _depth = self._children[name]
+            totals = (batches_c.value, samples_c.value, latency_h.sum)
+            last = self._reported.get(name, (0.0, 0.0, 0.0))
+            batches, samples, seconds = (t - l for t, l in zip(totals, last))
+            self._reported[name] = totals
             if batches:
                 logger.info(
                     f"[{name}] {int(batches)} batches, avg size {samples / batches:.1f}, "
                     f"{samples / max(seconds, 1e-9):.0f} samples/s device time"
                 )
-        self._stats.clear()
         try:
             from hivemind_tpu.utils.profiling import device_memory_stats
 

@@ -65,6 +65,11 @@ class TaskPool:
         return await task.future
 
     @property
+    def queue_size(self) -> int:
+        """Tasks currently waiting (telemetry: moe_pool_queue_depth)."""
+        return len(self._queue)
+
+    @property
     def priority(self) -> float:
         """Lower is more urgent: timestamp of the oldest queued task. A queue below
         min_batch_size is deprioritized only until its oldest task exceeds

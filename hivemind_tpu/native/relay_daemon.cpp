@@ -162,6 +162,7 @@ static bool channel_available = false;  // handshake ops resolved
 
 static bool load() {
   void* lib = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_LOCAL);
+  if (!lib) lib = dlopen("libcrypto.so.1.1", RTLD_NOW | RTLD_LOCAL);
   if (!lib) lib = dlopen("libcrypto.so", RTLD_NOW | RTLD_LOCAL);
   if (!lib) return false;
   new_raw_public_key = (decltype(new_raw_public_key))dlsym(lib, "EVP_PKEY_new_raw_public_key");
@@ -1000,6 +1001,14 @@ int main(int argc, char** argv) {
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_addr.s_addr = INADDR_ANY;
+  // argv[4]: optional TCP bind address. A PRIVATE daemon (spawned for one
+  // process with a unix control socket) binds 127.0.0.1 so it exposes no
+  // remote relay surface; public relay deployments keep the INADDR_ANY default.
+  const char* bind_host = argc > 4 && argv[4][0] != '\0' ? argv[4] : nullptr;
+  if (bind_host != nullptr && inet_pton(AF_INET, bind_host, &addr.sin_addr) != 1) {
+    fprintf(stderr, "relay: invalid bind address %s\n", bind_host);
+    return 1;
+  }
   addr.sin_port = htons((uint16_t)port);
   if (bind(listener, (sockaddr*)&addr, sizeof(addr)) < 0) { perror("bind"); return 1; }
   if (listen(listener, 128) < 0) { perror("listen"); return 1; }

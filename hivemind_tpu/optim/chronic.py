@@ -16,6 +16,18 @@ from hivemind_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
+# layer-4 telemetry (docs/observability.md): swarm-round outcomes and the chronic
+# counter, shared by the host Optimizer and SliceOptimizer via this mixin
+from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
+
+_ROUND_OUTCOMES = _TELEMETRY.counter(
+    "hivemind_optim_averaging_rounds_total", "attempted swarm averaging rounds", ("outcome",)
+)
+_G_CONSECUTIVE_FAILURES = _TELEMETRY.gauge(
+    "hivemind_optim_consecutive_failed_rounds",
+    "epochs in a row that degraded to local gradients (chronic past the threshold)",
+).labels()
+
 
 class ChronicFailureTracking:
     _chronic_peer_noun = "peer"
@@ -40,6 +52,7 @@ class ChronicFailureTracking:
         round was attempted (num_peers <= 1 — a solo peer is healthy, not failing)."""
         if averaged_ok is None:
             return
+        _ROUND_OUTCOMES.inc(outcome="ok" if averaged_ok else "degraded_to_local")
         if averaged_ok:
             if self.chronic_averaging_failure and self._should_log_chronic():
                 logger.info(
@@ -47,8 +60,10 @@ class ChronicFailureTracking:
                     f"{self._consecutive_failed_rounds} failed epochs"
                 )
             self._consecutive_failed_rounds = 0
+            _G_CONSECUTIVE_FAILURES.set(0)
             return
         self._consecutive_failed_rounds += 1
+        _G_CONSECUTIVE_FAILURES.set(self._consecutive_failed_rounds)
         if self._consecutive_failed_rounds == self.chronic_failure_threshold and self._should_log_chronic():
             logger.error(
                 f"{self._consecutive_failed_rounds} consecutive epochs degraded to local "

@@ -26,6 +26,30 @@ from hivemind_tpu.utils.timed_storage import DHTExpiration, get_dht_time
 
 logger = get_logger(__name__)
 
+# layer-4 telemetry (docs/observability.md). Hot path: report_local_progress runs
+# once per optimizer step, so the label-less children are bound once here —
+# each update is one lock + one float store.
+from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
+
+_G_LOCAL_EPOCH = _TELEMETRY.gauge(
+    "hivemind_optim_local_epoch", "this peer's local epoch"
+).labels()
+_G_LOCAL_SAMPLES = _TELEMETRY.gauge(
+    "hivemind_optim_local_samples_accumulated", "samples this peer accumulated toward the current epoch"
+).labels()
+_G_GLOBAL_EPOCH = _TELEMETRY.gauge(
+    "hivemind_optim_global_epoch", "swarm-wide epoch (max over peers)"
+).labels()
+_G_GLOBAL_SAMPLES = _TELEMETRY.gauge(
+    "hivemind_optim_global_samples_accumulated", "swarm-wide samples toward target_batch_size"
+).labels()
+_G_NUM_PEERS = _TELEMETRY.gauge(
+    "hivemind_optim_num_peers", "peers reporting progress on this run"
+).labels()
+_G_SAMPLES_PER_SECOND = _TELEMETRY.gauge(
+    "hivemind_optim_swarm_samples_per_second", "aggregate swarm throughput estimate"
+).labels()
+
 
 class LocalTrainingProgress(pydantic.BaseModel):
     peer_id: bytes
@@ -181,6 +205,8 @@ class ProgressTracker:
                 time=get_dht_time(),
                 client_mode=self.client_mode,
             )
+        _G_LOCAL_EPOCH.set(local_epoch)
+        _G_LOCAL_SAMPLES.set(samples_accumulated)
         self._wake_reporter()
         # our own progress may be what completes the epoch (always true for small
         # swarms): re-aggregate NOW instead of sleeping out the adaptive refresh —
@@ -296,6 +322,10 @@ class ProgressTracker:
                 eta_next_epoch=get_dht_time() + eta_seconds,
                 next_fetch_time=get_dht_time() + refresh,
             )
+        _G_GLOBAL_EPOCH.set(global_epoch)
+        _G_GLOBAL_SAMPLES.set(samples)
+        _G_NUM_PEERS.set(num_peers)
+        _G_SAMPLES_PER_SECOND.set(samples_per_second)
 
     async def fetch_global_progress_now(self) -> GlobalTrainingProgress:
         await self._fetch_global_progress()

@@ -83,6 +83,22 @@ from hivemind_tpu.utils.timed_storage import get_dht_time
 
 logger = get_logger(__name__)
 
+# layer-4 telemetry (docs/observability.md). The skipped-steps child is bound
+# once: it increments on the broadcast-free hot path.
+from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
+
+_C_SKIPPED_STEPS = _TELEMETRY.counter(
+    "hivemind_optim_skipped_broadcast_steps_total",
+    "steps that skipped the per-step decision broadcast (thinning)",
+).labels()
+_C_EPOCH_TRANSITIONS = _TELEMETRY.counter(
+    "hivemind_optim_epoch_transitions_total", "slice epoch transitions", ("kind",)
+)
+_C_POISONED_ROUNDS = _TELEMETRY.counter(
+    "hivemind_optim_poisoned_averager_rounds_total",
+    "delayed rounds whose thread outlived its join timeout, poisoning the grad averager",
+).labels()
+
 
 def _broadcast(value: np.ndarray) -> np.ndarray:
     """Broadcast one host array from process 0 to all processes (device collective)."""
@@ -206,6 +222,10 @@ class SliceOptimizer(ChronicFailureTracking):
         self._pending: Optional[dict] = None
         self._bg_thread: Optional[threading.Thread] = None  # process 0 only
         self._bg_outcome: Optional[dict] = None  # process 0 only
+        # a delayed-round thread that outlived its join timeout still owns the
+        # grad averager's shared tensors: until it is confirmed dead the averager
+        # is POISONED and must not be reused (silent data race otherwise)
+        self._poisoned_bg_thread: Optional[threading.Thread] = None  # process 0 only
         # broadcast thinning, also replicated: process 0 announces a skip count in
         # the decision vector; every process counts the same number down
         self._skip_remaining = 0
@@ -345,6 +365,7 @@ class SliceOptimizer(ChronicFailureTracking):
             # step (raising here would desync the skip countdown).
             if self._skip_remaining > 0:
                 self._skip_remaining -= 1
+                _C_SKIPPED_STEPS.inc()
                 if self.is_network_process and self._deferred_network_error is None:
                     try:
                         assert self.tracker is not None
@@ -470,12 +491,22 @@ class SliceOptimizer(ChronicFailureTracking):
         ):
             return 0
         assert self.tracker is not None
-        eta = self.tracker.global_progress.eta_next_epoch - get_dht_time()
+        progress = self.tracker.global_progress
+        eta = progress.eta_next_epoch - get_dht_time()
         # stay broadcast-per-step inside the pre-scheduling window so the group
         # forms at full cadence, and keep a 2x step-time safety margin
         if eta <= max(self.matchmaking_time * 2, 4 * self._step_time_ema):
             return 0
-        return min(self.max_broadcast_skip, int(eta / (2 * self._step_time_ema)))
+        # additionally cap by the locally-known samples remaining to the target:
+        # the ETA extrapolates the swarm's PAST rate, so a swarm speed-up (new
+        # peers joining mid-window) can close the epoch well before it — the
+        # sample count cannot be outrun the same way, and with the same 2x
+        # margin our own contribution can cover at most half the known gap
+        # before the next broadcast re-checks
+        per_step = max(int(self.batch_size_per_step or 1), 1)
+        remaining_samples = max(progress.target_batch_size - progress.samples_accumulated, 0)
+        steps_to_target = int(remaining_samples // (2 * per_step))
+        return min(self.max_broadcast_skip, int(eta / (2 * self._step_time_ema)), steps_to_target)
 
     # ------------------------------------------------------------------ delayed rounds
 
@@ -488,6 +519,7 @@ class SliceOptimizer(ChronicFailureTracking):
         also resets the tracker so ``ready`` cannot re-fire into an immediate
         blocking join), and — network process only — launch the swarm round on
         a background thread."""
+        _C_EPOCH_TRANSITIONS.inc(kind="delayed_launch")
         inv = jnp.float32(1.0 / max(self._samples, 1))
         normalized = self._jit_normalize(self._accum, inv)
         scratch = self.bridge.gather_to_host(normalized)
@@ -546,14 +578,42 @@ class SliceOptimizer(ChronicFailureTracking):
     def _discard_pending(self) -> None:
         """Drop an in-flight delayed round (all processes; the catch-up path is
         about to replace the state it would have updated). Process 0 waits the
-        background thread out so the averager is free for the state download."""
+        background thread out so the averager is free for the state download; a
+        thread that survives the join timeout POISONS the grad averager — its
+        buffers are not reused until the thread is confirmed dead (a wedged round
+        writing into tensors a new round is reading is a silent data race)."""
         if self._pending is None:
             return
         self._pending = None
         if self.is_network_process and self._bg_thread is not None:
             self._bg_thread.join(timeout=self.averaging_timeout + 30.0)
+            if self._bg_thread.is_alive():
+                self._poisoned_bg_thread = self._bg_thread
+                _C_POISONED_ROUNDS.inc()
+                logger.error(
+                    "a discarded delayed averaging round did not terminate within "
+                    f"{self.averaging_timeout + 30.0:.0f}s; the grad averager is POISONED — "
+                    "swarm gradient rounds degrade to local gradients until the round "
+                    "thread is confirmed dead (see "
+                    "hivemind_optim_poisoned_averager_rounds_total)"
+                )
         self._bg_thread = None
         self._bg_outcome = None
+
+    def _grad_averager_poisoned(self) -> bool:
+        """True while a timed-out delayed-round thread may still touch the grad
+        averager's buffers; self-clears once the thread is confirmed dead."""
+        thread = self._poisoned_bg_thread
+        if thread is None:
+            return False
+        if thread.is_alive():
+            return True
+        self._poisoned_bg_thread = None
+        logger.warning(
+            "the poisoned delayed-round thread has terminated; grad averager "
+            "buffers are safe to reuse again"
+        )
+        return False
 
     # ------------------------------------------------------------------ scheduling
 
@@ -567,6 +627,8 @@ class SliceOptimizer(ChronicFailureTracking):
             # pre-scheduling re-declares in the DHT at full cadence every step;
             # under chronic failure only the (backed-off) step-time path matchmakes
             return
+        if self._grad_averager_poisoned():
+            return  # a wedged round still owns the averager's buffers
         eta = self.tracker.global_progress.eta_next_epoch - get_dht_time()
         if eta <= self.matchmaking_time * 2 and self._scheduled_control_invalid():
             scheduled_time = get_dht_time() + max(eta, 1e-2)
@@ -601,6 +663,13 @@ class SliceOptimizer(ChronicFailureTracking):
         claimed control is cancelled so matched groupmates are not stranded."""
         try:
             assert self.grad_averager is not None
+            if self._grad_averager_poisoned():
+                # refusing to touch the shared tensors IS the fix: the wedged
+                # thread may still be writing them (loud log already emitted)
+                if control is not None and not control.done():
+                    with contextlib.suppress(Exception):
+                        control.cancel()
+                return False
             with self.grad_averager.get_tensors() as tensors:
                 for tensor, fresh in zip(tensors, scratch):
                     np.copyto(tensor, fresh)
@@ -645,6 +714,7 @@ class SliceOptimizer(ChronicFailureTracking):
         """The slice analog of reference _update_global_epoch (optimizer.py:438-509):
         stage → swarm-average (p0) → broadcast → collective optax update → state round."""
 
+        _C_EPOCH_TRANSITIONS.inc(kind="synchronous")
         # phase A (collective): normalize the on-device accumulator and stage it to
         # identical full host copies on EVERY process (per-leaf bounded staging).
         # These doubles as the local-gradient fallback: if the swarm round fails,
@@ -793,6 +863,7 @@ class SliceOptimizer(ChronicFailureTracking):
         # failure path every process must adopt the SAME epoch (process 0's view
         # can differ from a follower's argument, and divergent epochs desync the
         # collective schedule of later phases)
+        _C_EPOCH_TRANSITIONS.inc(kind="catch_up")
         header = np.asarray([0.0, float(global_epoch)], np.float32)
         tensors: Optional[List[np.ndarray]] = None
         if self.is_network_process:

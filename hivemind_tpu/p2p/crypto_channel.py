@@ -36,11 +36,22 @@ import struct
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Tuple
 
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric.x25519 import X25519PrivateKey, X25519PublicKey
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+try:
+    from cryptography.exceptions import InvalidTag
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric.x25519 import X25519PrivateKey, X25519PublicKey
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+except ImportError:  # no cryptography wheel on this image: system libcrypto shim
+    from hivemind_tpu.utils._libcrypto import (
+        ChaCha20Poly1305,
+        HKDF,
+        InvalidTag,
+        X25519PrivateKey,
+        X25519PublicKey,
+        hashes,
+        serialization,
+    )
 
 from hivemind_tpu.utils.crypto import Ed25519PrivateKey, Ed25519PublicKey
 from hivemind_tpu.utils.serializer import MSGPackSerializer

@@ -131,6 +131,24 @@ def read_daemon_banner(process: subprocess.Popen, timeout: float):
     return lines[0].strip(), lines[1].strip()
 
 
+def _listener_binds_any(port: int) -> Optional[bool]:
+    """True if a LISTEN socket on ``port`` is bound to 0.0.0.0 (read from
+    /proc/net/tcp); None when that table is unavailable or the port is absent."""
+    try:
+        with open("/proc/net/tcp") as table:
+            next(table)  # header
+            for line in table:
+                fields = line.split()
+                if len(fields) < 4 or fields[3] != "0A":  # 0A = TCP_LISTEN
+                    continue
+                addr_hex, _, port_hex = fields[1].partition(":")
+                if int(port_hex, 16) == port:
+                    return addr_hex == "00000000"
+    except (OSError, ValueError, StopIteration):
+        return None
+    return None
+
+
 def spawn_native_transport(
     workdir: Optional[str] = None, banner_timeout: float = 30.0
 ) -> Optional[NativeTransportDaemon]:
@@ -148,8 +166,12 @@ def spawn_native_transport(
     owns_workdir = workdir is None
     workdir = workdir or tempfile.mkdtemp(prefix="hivemind_native_")
     unix_path = os.path.join(workdir, "data_plane.sock")
+    # 127.0.0.1: a PRIVATE daemon's control surface is the 0600 unix socket; its
+    # TCP listener (relay/'Y' control) must not be reachable from off-host, so a
+    # zero-config spawn exposes no remote relay surface (advisory at the old
+    # INADDR_ANY spawn). Public relays are started explicitly, without this arg.
     process = subprocess.Popen(
-        [str(binary), "0", "", unix_path],
+        [str(binary), "0", "", unix_path, "127.0.0.1"],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
         preexec_fn=_die_with_parent,
     )
@@ -175,5 +197,13 @@ def spawn_native_transport(
     if not os.path.exists(unix_path):
         _give_up("daemon did not create its unix socket")
         return None
+    if _listener_binds_any(port):
+        # a binary predating the bind-host argument ignores argv[4] and binds
+        # INADDR_ANY — the loopback confinement silently fails open; say so
+        logger.warning(
+            "the private relay daemon bound its TCP listener to 0.0.0.0 (stale "
+            "binary predating the bind-host argument?); rebuild hivemind_tpu/native "
+            "with `make` to confine the relay surface to loopback"
+        )
     logger.debug(f"private data-plane daemon up (pid {process.pid}, socket {unix_path})")
     return NativeTransportDaemon(process, unix_path, port, workdir, owns_workdir)

@@ -30,6 +30,8 @@ from typing import (
     Union,
 )
 
+import time
+
 from hivemind_tpu.p2p.crypto_channel import HandshakeError, handshake
 from hivemind_tpu.p2p.mux import (
     Flags,
@@ -46,6 +48,21 @@ logger = get_logger(__name__)
 
 TRequest = TypeVar("TRequest")
 TResponse = TypeVar("TResponse")
+
+# layer-1 telemetry (docs/observability.md): per-handler RPC latency, payload
+# bytes and failures on both sides of the wire. label `side`: "server" for
+# handlers this peer serves, "client" for calls it makes.
+from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
+
+_RPC_LATENCY = _TELEMETRY.histogram(
+    "hivemind_p2p_rpc_latency_seconds", "wall time of one RPC", ("handler", "side")
+)
+_RPC_BYTES = _TELEMETRY.counter(
+    "hivemind_p2p_rpc_bytes_total", "serialized RPC payload bytes", ("handler", "direction")
+)
+_RPC_ERRORS = _TELEMETRY.counter(
+    "hivemind_p2p_rpc_errors_total", "RPCs that failed", ("handler", "side")
+)
 
 from hivemind_tpu.p2p.mux import MAX_MESSAGE_SIZE as DEFAULT_MAX_MSG_SIZE  # enforced in MuxStream.send
 
@@ -388,6 +405,8 @@ class P2P:
         listener's lifetime to it."""
         import struct
 
+        writer = None
+        registered = failed = False
         try:
             reader, writer = await asyncio.wait_for(self._open_daemon_connection(), timeout=5.0)
             request = b"Y" + struct.pack(">HH", public_port, local_port)
@@ -398,6 +417,7 @@ class P2P:
             response = await asyncio.wait_for(reader.readexactly(length), timeout=5.0)
             if len(response) == 3 and response[0:1] == b"O":
                 self._inbound_proxy_writer = writer
+                registered = True
                 # the daemon ties the public listener to this conn: watch it —
                 # a daemon crash otherwise leaves us announcing a dead port
                 # forever while outbound dials keep working and mask the loss
@@ -405,9 +425,20 @@ class P2P:
                 self._bg_tasks.add(watchdog)
                 watchdog.add_done_callback(self._bg_tasks.discard)
                 return struct.unpack(">H", response[1:3])[0]
-            writer.close()
+            # a well-formed non-'O' reply is an expected REFUSAL, not an error
         except (ConnectionError, OSError, asyncio.TimeoutError, asyncio.IncompleteReadError) as e:
+            failed = True
             logger.debug(f"inbound proxy registration failed: {e!r}")
+        finally:
+            # a registration that did not become the control conn must ALWAYS
+            # close its writer — a mid-handshake timeout/refusal otherwise leaks
+            # the daemon connection for the process lifetime (ADVICE r5). Only
+            # genuine mid-handshake failures count toward the error metric
+            # (refusals and cancellations are expected outcomes).
+            if writer is not None and not registered:
+                if failed:
+                    _RPC_ERRORS.inc(handler="_register_inbound_proxy", side="client")
+                writer.close()
         return None
 
     async def _watch_inbound_proxy(self, reader: asyncio.StreamReader) -> None:
@@ -700,42 +731,61 @@ class P2P:
     async def _route_stream(self, stream: MuxStream) -> None:
         handler = self._handlers.get(stream.handler_name)
         if handler is None:
+            # fixed label: the name is remote-controlled, and label values live
+            # forever — a peer cycling fake names must not grow the registry
+            _RPC_ERRORS.inc(handler="<unknown>", side="server")
             await stream.send_error(P2PHandlerError(f"unknown handler {stream.handler_name!r}"))
             await stream.close_send()
             return
         context = P2PContext(stream.handler_name, self.peer_id, stream.peer_id)
+        started = time.perf_counter()
+        bytes_in = bytes_out = 0
         try:
             if handler.stream_input:
-                request: Any = self._parse_stream(stream, handler.request_type)
+                async def _counted_stream():
+                    nonlocal bytes_in
+                    async for message in stream.iter_messages():
+                        bytes_in += len(message)
+                        yield _parse(message, handler.request_type)
+
+                request: Any = _counted_stream()
             else:
-                request = _parse(await stream.receive(), handler.request_type)
+                raw_request = await stream.receive()
+                bytes_in += len(raw_request)
+                request = _parse(raw_request, handler.request_type)
 
             if handler.stream_output:
                 result = handler.fn(request, context)
                 if asyncio.iscoroutine(result):
                     result = await result
                 async for response in result:
-                    await stream.send(_serialize(response))
+                    payload = _serialize(response)
+                    bytes_out += len(payload)
+                    await stream.send(payload)
             else:
                 response = await handler.fn(request, context)
-                await stream.send(_serialize(response))
+                payload = _serialize(response)
+                bytes_out += len(payload)
+                await stream.send(payload)
             await stream.close_send()
         except StreamClosedError:
             return  # peer reset/vanished mid-call: normal termination for a handler
         except asyncio.CancelledError:
             raise
         except Exception as e:
+            _RPC_ERRORS.inc(handler=stream.handler_name, side="server")
             logger.debug(f"handler {stream.handler_name} failed: {e!r}")
             try:
                 await stream.send_error(e)
                 await stream.close_send()
             except StreamClosedError:
                 pass
-
-    @staticmethod
-    async def _parse_stream(stream: MuxStream, request_type: Optional[Type]) -> AsyncIterator:
-        async for message in stream.iter_messages():
-            yield _parse(message, request_type)
+        finally:
+            _RPC_LATENCY.observe(time.perf_counter() - started, handler=stream.handler_name, side="server")
+            if bytes_in:
+                _RPC_BYTES.inc(bytes_in, handler=stream.handler_name, direction="in")
+            if bytes_out:
+                _RPC_BYTES.inc(bytes_out, handler=stream.handler_name, direction="out")
 
     # ------------------------------------------------------------------ calls
 
@@ -771,35 +821,46 @@ class P2P:
         risk double-applying an optimizer step or double-advancing a KV cache.
         """
         payload = _serialize(request)
-        for attempt in range(2):
-            stream = await self._open_stream_with_redial(peer_id, name)
-            try:
+        started = time.perf_counter()
+        try:
+            for attempt in range(2):
+                stream = await self._open_stream_with_redial(peer_id, name)
                 try:
-                    await stream.send(payload)
-                    await stream.close_send()
-                except StreamClosedError:
-                    # the request never left: safe to retry for any RPC
-                    if attempt == 0:
-                        continue
-                    raise P2PHandlerError(f"{name}: connection closed before request was sent") from None
-                try:
-                    response = await stream.receive()
-                except RemoteError as e:
-                    raise P2PHandlerError(str(e)) from e
-                except StreamClosedError:
-                    # nothing was received, but the request WAS sent: the peer may
-                    # or may not have processed it. Only retry when the caller
-                    # declared the RPC idempotent (reads: rpc_info, DHT ping/find,
-                    # or set-semantics writes like rpc_store).
-                    if idempotent and attempt == 0 and stream._conn.is_closed:
-                        continue
-                    raise P2PHandlerError(
-                        f"{name}: stream closed before response"
-                        + ("" if idempotent else " (not retried: RPC not marked idempotent)")
-                    ) from None
-                return _parse(response, response_type)
-            finally:
-                await stream.reset()
+                    try:
+                        await stream.send(payload)
+                        await stream.close_send()
+                    except StreamClosedError:
+                        # the request never left: safe to retry for any RPC
+                        if attempt == 0:
+                            continue
+                        raise P2PHandlerError(f"{name}: connection closed before request was sent") from None
+                    try:
+                        response = await stream.receive()
+                    except RemoteError as e:
+                        raise P2PHandlerError(str(e)) from e
+                    except StreamClosedError:
+                        # nothing was received, but the request WAS sent: the peer may
+                        # or may not have processed it. Only retry when the caller
+                        # declared the RPC idempotent (reads: rpc_info, DHT ping/find,
+                        # or set-semantics writes like rpc_store).
+                        if idempotent and attempt == 0 and stream._conn.is_closed:
+                            continue
+                        raise P2PHandlerError(
+                            f"{name}: stream closed before response"
+                            + ("" if idempotent else " (not retried: RPC not marked idempotent)")
+                        ) from None
+                    _RPC_BYTES.inc(len(payload), handler=name, direction="out")
+                    _RPC_BYTES.inc(len(response), handler=name, direction="in")
+                    return _parse(response, response_type)
+                finally:
+                    await stream.reset()
+        except asyncio.CancelledError:
+            raise
+        except BaseException:
+            _RPC_ERRORS.inc(handler=name, side="client")
+            raise
+        finally:
+            _RPC_LATENCY.observe(time.perf_counter() - started, handler=name, side="client")
 
     async def iterate_protobuf_handler(
         self,
@@ -813,12 +874,17 @@ class P2P:
         stream = await self._open_stream_with_redial(peer_id, name)
 
         async def _feed():
+            nonlocal bytes_out
             try:
                 if hasattr(requests, "__aiter__"):
                     async for request in requests:
-                        await stream.send(_serialize(request))
+                        payload = _serialize(request)
+                        bytes_out += len(payload)
+                        await stream.send(payload)
                 else:
-                    await stream.send(_serialize(requests))
+                    payload = _serialize(requests)
+                    bytes_out += len(payload)
+                    await stream.send(payload)
                 await stream.close_send()
             except (StreamClosedError, asyncio.CancelledError):
                 pass
@@ -828,6 +894,8 @@ class P2P:
                 await stream.reset()
                 raise
 
+        started = time.perf_counter()
+        bytes_in = bytes_out = 0
         feeder = asyncio.create_task(_feed())
         try:
             while True:
@@ -835,13 +903,21 @@ class P2P:
                     message = await stream.receive()
                 except StreamClosedError:
                     if feeder.done() and not feeder.cancelled() and feeder.exception() is not None:
+                        _RPC_ERRORS.inc(handler=name, side="client")
                         raise feeder.exception()
                     return
                 except RemoteError as e:
+                    _RPC_ERRORS.inc(handler=name, side="client")
                     raise P2PHandlerError(str(e)) from e
+                bytes_in += len(message)
                 yield _parse(message, response_type)
         finally:
             feeder.cancel()
+            _RPC_LATENCY.observe(time.perf_counter() - started, handler=name, side="client")
+            if bytes_in:
+                _RPC_BYTES.inc(bytes_in, handler=name, direction="in")
+            if bytes_out:
+                _RPC_BYTES.inc(bytes_out, handler=name, direction="out")
             await stream.reset()
 
     # ------------------------------------------------------------------ lifecycle

@@ -19,12 +19,27 @@ import os
 import struct
 from typing import Optional, Tuple
 
-from cryptography.exceptions import InvalidSignature, InvalidTag
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ed25519 as raw_ed25519
-from cryptography.hazmat.primitives.asymmetric.x25519 import X25519PrivateKey, X25519PublicKey
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+try:
+    from cryptography.exceptions import InvalidSignature, InvalidTag
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ed25519 as raw_ed25519
+    from cryptography.hazmat.primitives.asymmetric.x25519 import X25519PrivateKey, X25519PublicKey
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+except ImportError:  # no cryptography wheel on this image: system libcrypto shim
+    from hivemind_tpu.utils import _libcrypto as _compat
+    from hivemind_tpu.utils._libcrypto import (
+        ChaCha20Poly1305,
+        HKDF,
+        InvalidSignature,
+        InvalidTag,
+        X25519PrivateKey,
+        X25519PublicKey,
+        hashes,
+        serialization,
+    )
+
+    raw_ed25519 = _compat.ed25519
 
 from hivemind_tpu.p2p.crypto_channel import handshake
 from hivemind_tpu.p2p.mux import MuxConnection

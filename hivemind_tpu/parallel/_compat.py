@@ -1,0 +1,23 @@
+"""jax version-compatibility shims shared by the parallel layer (and its tests).
+
+- ``shard_map``: top-level since jax 0.8, ``jax.experimental.shard_map`` before.
+- ``NO_CHECK``: the kwargs disabling the replication/varying-axes checker, whose
+  flag was renamed ``check_rep`` -> ``check_vma`` across versions. Both shims
+  live here so the next jax rename is a one-file fix.
+"""
+
+import inspect
+
+try:  # top-level since jax 0.8; experimental path for older versions
+    from jax import shard_map
+except ImportError:  # pragma: no cover - depends on the installed jax
+    from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
+
+_NO_CHECK_FLAG = (
+    "check_vma" if "check_vma" in inspect.signature(shard_map).parameters else "check_rep"
+)
+# pass **NO_CHECK to shard_map when the checker cannot see through the body
+# (e.g. pallas_call outputs)
+NO_CHECK = {_NO_CHECK_FLAG: False}
+
+__all__ = ["shard_map", "NO_CHECK"]

@@ -32,10 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # top-level since jax 0.8; experimental path for older versions
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
+from hivemind_tpu.parallel._compat import shard_map
 
 
 def _leaf_spec(leaf) -> P:

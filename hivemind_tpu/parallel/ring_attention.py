@@ -154,8 +154,9 @@ def mesh_attention_core(mesh, q, k, v, mask=None, causal: bool = False):
     single-device `plain_attention`. ``mask`` (key-validity) is only supported on
     the single-device path: ring shards carry full sequences."""
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
+
+        from hivemind_tpu.parallel._compat import NO_CHECK, shard_map
 
         from hivemind_tpu.ops.pallas_attention import _flash_enabled, _flash_forced
 
@@ -169,7 +170,7 @@ def mesh_attention_core(mesh, q, k, v, mask=None, causal: bool = False):
             def inner(q, k, v):
                 return ring_flash_attention(q, k, v, "sp", False, causal)
 
-            extra["check_vma"] = False
+            extra.update(NO_CHECK)
         else:
             inner = partial(ring_attention, axis_name="sp", causal=causal)
         core = shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **extra)

@@ -1,0 +1,47 @@
+"""Swarm-wide telemetry (ISSUE 2): a zero-dependency, thread-safe metrics
+registry with a Prometheus text exporter and DHT-published peer snapshots.
+
+- :mod:`~hivemind_tpu.telemetry.registry` — Counter / Gauge / Histogram with
+  labels; the process-wide :data:`REGISTRY` all layers record into.
+- :mod:`~hivemind_tpu.telemetry.exporter` — ``GET /metrics`` over stdlib HTTP
+  (``--metrics-port`` in run_server.py / run_dht.py).
+- :mod:`~hivemind_tpu.telemetry.monitor` — per-peer DHT snapshot publisher and
+  the swarm-wide aggregation view.
+
+See docs/observability.md for the metric catalog.
+"""
+
+from hivemind_tpu.telemetry.exporter import MetricsExporter, render_prometheus
+from hivemind_tpu.telemetry.monitor import (
+    DEFAULT_TELEMETRY_KEY,
+    SwarmMonitor,
+    TelemetryPublisher,
+    aggregate_swarm_view,
+    build_peer_snapshot,
+    fetch_swarm_telemetry,
+)
+from hivemind_tpu.telemetry.registry import (
+    DEFAULT_BUCKETS,
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+
+__all__ = [
+    "REGISTRY",
+    "DEFAULT_BUCKETS",
+    "DEFAULT_TELEMETRY_KEY",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "MetricsExporter",
+    "render_prometheus",
+    "TelemetryPublisher",
+    "SwarmMonitor",
+    "build_peer_snapshot",
+    "fetch_swarm_telemetry",
+    "aggregate_swarm_view",
+]

@@ -1,0 +1,145 @@
+"""Prometheus text-format exposition over a stdlib HTTP endpoint.
+
+``render_prometheus`` produces the text exposition format (version 0.0.4) from a
+:class:`~hivemind_tpu.telemetry.registry.MetricsRegistry`; ``MetricsExporter``
+serves it at ``GET /metrics`` from a daemon-threaded ``ThreadingHTTPServer`` —
+no ``prometheus_client`` dependency (acceptance criterion), nothing async, and
+zero cost to the instrumented process until something actually scrapes.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from hivemind_tpu.telemetry.registry import REGISTRY, MetricsRegistry
+from hivemind_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _escape_label_value(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _format_labels(labelnames, labelvalues, extra: str = "") -> str:
+    parts = [f'{n}="{_escape_label_value(str(v))}"' for n, v in zip(labelnames, labelvalues)]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _format_value(value: float) -> str:
+    if value == float("inf"):
+        return "+Inf"
+    if value == float("-inf"):
+        return "-Inf"
+    if float(value).is_integer() and abs(value) < 2**53:
+        return str(int(value))
+    return repr(float(value))
+
+
+def render_prometheus(registry: MetricsRegistry = REGISTRY) -> str:
+    """The registry as Prometheus text exposition (one scrape)."""
+    lines = []
+    for metric in registry.collect():
+        name = metric.name
+        lines.append(f"# HELP {name} {metric.documentation or name}")
+        lines.append(f"# TYPE {name} {metric.metric_type}")
+        if metric.metric_type == "histogram":
+            for key, child in metric.series():
+                buckets, total, count = child.snapshot()
+                for bound, cumulative in zip(metric.buckets, buckets):
+                    labels = _format_labels(metric.labelnames, key, f'le="{_format_value(bound)}"')
+                    lines.append(f"{name}_bucket{labels} {cumulative}")
+                labels = _format_labels(metric.labelnames, key, 'le="+Inf"')
+                lines.append(f"{name}_bucket{labels} {count}")
+                plain = _format_labels(metric.labelnames, key)
+                lines.append(f"{name}_sum{plain} {_format_value(total)}")
+                lines.append(f"{name}_count{plain} {count}")
+        else:
+            # counters expose a _total sample; a declared ..._total name is kept as-is
+            sample = name
+            if metric.metric_type == "counter" and not name.endswith("_total"):
+                sample = name + "_total"
+            for key, child in metric.series():
+                labels = _format_labels(metric.labelnames, key)
+                lines.append(f"{sample}{labels} {_format_value(child.value)}")
+    return "\n".join(lines) + "\n"
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    registry: MetricsRegistry = REGISTRY  # overridden per-server
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        path = self.path.split("?", 1)[0]
+        if path in ("/metrics", "/"):
+            body = render_prometheus(self.registry).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+        elif path == "/metrics.json":
+            body = json.dumps(self.registry.snapshot()).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+        elif path == "/healthz":
+            body = b"ok\n"
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+        else:
+            body = b"not found\n"
+            self.send_response(404)
+            self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, format, *args):  # noqa: A002 (stdlib API)
+        pass  # scrapes must not spam the training logs
+
+
+class MetricsExporter:
+    """Serve ``/metrics`` (Prometheus text), ``/metrics.json`` (compact snapshot)
+    and ``/healthz`` on a daemon thread.
+
+    :param port: TCP port; 0 picks a free one (read it back via ``.port``)
+    :param host: bind host; default loopback — pass "0.0.0.0" for remote scrapers
+    """
+
+    def __init__(
+        self,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        registry: MetricsRegistry = REGISTRY,
+        start: bool = True,
+    ):
+        self.registry = registry
+        handler = type("_BoundMetricsHandler", (_MetricsHandler,), {"registry": registry})
+        self._server = ThreadingHTTPServer((host, port), handler)
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="metrics-exporter", daemon=True
+        )
+        self._thread.start()
+        logger.info(f"metrics exporter listening on :{self.port}/metrics")
+
+    def shutdown(self) -> None:
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            self._server.shutdown()
+            thread.join(timeout=5.0)
+        self._server.server_close()

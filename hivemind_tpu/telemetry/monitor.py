@@ -1,0 +1,220 @@
+"""Swarm-wide telemetry: publish each peer's snapshot to the DHT, aggregate all
+peers' snapshots into one view.
+
+Per-peer side — :class:`TelemetryPublisher`: a daemon thread stores a compact
+snapshot of the process-wide registry (plus optional caller extras, e.g. a
+``StepProfiler.summary()``) under ``{key}`` / subkey ``peer_id`` on a timer, so
+one DHT read answers "where did this round's time go" for the whole swarm.
+
+Monitor side — :func:`fetch_swarm_telemetry` + :func:`aggregate_swarm_view` and
+the :class:`SwarmMonitor` convenience wrapper, which can stream the aggregate
+into a :class:`~hivemind_tpu.utils.profiling.JsonlMetricsSink` (the offline
+wandb-style sink the flagship recipe's monitor already uses).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from hivemind_tpu.telemetry.registry import REGISTRY, MetricsRegistry
+from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.timed_storage import get_dht_time
+
+logger = get_logger(__name__)
+
+DEFAULT_TELEMETRY_KEY = "hivemind_telemetry"
+# a snapshot must stay a small DHT record: drop histogram series first, then
+# whole metrics, before giving up on the publish
+_MAX_SNAPSHOT_BYTES = 48 * 1024
+
+
+def build_peer_snapshot(
+    registry: MetricsRegistry = REGISTRY, extras: Optional[Dict[str, Any]] = None
+) -> Dict[str, Any]:
+    """One peer's compact telemetry record (msgpack/JSON-able)."""
+    snapshot = {
+        "time": get_dht_time(),
+        "metrics": registry.snapshot(),
+    }
+    if extras:
+        snapshot.update(extras)
+    return snapshot
+
+
+def _shrink_to_fit(snapshot: Dict[str, Any], max_bytes: int = _MAX_SNAPSHOT_BYTES) -> Dict[str, Any]:
+    from hivemind_tpu.utils.serializer import MSGPackSerializer
+
+    if len(MSGPackSerializer.dumps(snapshot)) <= max_bytes:
+        return snapshot
+    metrics = dict(snapshot.get("metrics", {}))
+    # histograms are the bulky families; their count/sum alone usually suffices
+    # for the swarm view, so drop the largest families until the record fits
+    by_size = sorted(metrics, key=lambda name: -len(str(metrics[name])))
+    for name in by_size:
+        metrics.pop(name)
+        shrunk = {**snapshot, "metrics": metrics, "truncated": True}
+        if len(MSGPackSerializer.dumps(shrunk)) <= max_bytes:
+            return shrunk
+    return {**snapshot, "metrics": {}, "truncated": True}
+
+
+class TelemetryPublisher:
+    """Periodically store this peer's snapshot in the DHT (one subkey per peer).
+
+    :param dht: the peer's :class:`~hivemind_tpu.dht.DHT`
+    :param key: DHT key to publish under; swarm members must agree on it
+        (convention: ``f"{run_id}_telemetry"`` for training runs)
+    :param interval: seconds between publishes
+    :param extras_fn: zero-arg callable merged into every snapshot (e.g.
+        ``lambda: {"step_profiler": profiler.summary()}``)
+    """
+
+    def __init__(
+        self,
+        dht,
+        key: str = DEFAULT_TELEMETRY_KEY,
+        *,
+        interval: float = 30.0,
+        registry: MetricsRegistry = REGISTRY,
+        extras_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        start: bool = True,
+    ):
+        self.dht = dht
+        self.key = key
+        self.interval = interval
+        self.registry = registry
+        self.extras_fn = extras_fn
+        self.last_published: Optional[Dict[str, Any]] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="telemetry-publisher", daemon=True
+        )
+        self._thread.start()
+
+    def publish_once(self) -> bool:
+        """Build + store one snapshot now (also used by the timer thread)."""
+        extras: Dict[str, Any] = {}
+        if self.extras_fn is not None:
+            try:
+                extras = dict(self.extras_fn())
+            except Exception as e:
+                logger.debug(f"telemetry extras_fn failed: {e!r}")
+        extras.setdefault("peer_id", str(self.dht.peer_id))
+        snapshot = _shrink_to_fit(build_peer_snapshot(self.registry, extras))
+        try:
+            ok = self.dht.store(
+                self.key,
+                value=snapshot,
+                subkey=self.dht.peer_id.to_bytes(),
+                expiration_time=get_dht_time() + max(self.interval * 3, 60.0),
+            )
+        except Exception as e:
+            logger.debug(f"telemetry publish failed: {e!r}")
+            return False
+        if ok:
+            self.last_published = snapshot
+        return bool(ok)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.publish_once()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=2.0)
+
+
+# ------------------------------------------------------------------ monitor side
+
+
+def fetch_swarm_telemetry(dht, key: str = DEFAULT_TELEMETRY_KEY) -> Dict[str, Dict[str, Any]]:
+    """All peers' live snapshots: ``{peer_id_str: snapshot_dict}``."""
+    response = dht.get(key, latest=True)
+    records: Dict[str, Dict[str, Any]] = {}
+    if response is None or not isinstance(response.value, dict):
+        return records
+    for subkey, entry in response.value.items():
+        snapshot = entry.value if hasattr(entry, "value") else entry
+        if not isinstance(snapshot, dict):
+            continue
+        peer = snapshot.get("peer_id")
+        if not isinstance(peer, str):
+            peer = subkey.hex() if isinstance(subkey, bytes) else str(subkey)
+        records[peer] = snapshot
+    return records
+
+
+def aggregate_swarm_view(records: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+    """Collapse per-peer snapshots into the swarm-wide view: counter/gauge totals
+    per metric (counters/histogram-counts sum; gauges also carry min/max so a
+    straggler epoch is visible), plus a per-peer health summary."""
+    totals: Dict[str, Dict[str, Any]] = {}
+    peers: Dict[str, Dict[str, Any]] = {}
+    now = get_dht_time()
+    for peer, snapshot in records.items():
+        peers[peer] = {
+            "age_s": round(max(now - float(snapshot.get("time", now)), 0.0), 1),
+            **{k: v for k, v in snapshot.items() if k not in ("metrics", "time", "peer_id")},
+        }
+        for name, family in (snapshot.get("metrics") or {}).items():
+            ftype = family.get("type", "untyped")
+            agg = totals.setdefault(name, {"type": ftype, "total": 0.0, "peers": 0})
+            agg["peers"] += 1
+            for _label, value in (family.get("series") or {}).items():
+                if isinstance(value, dict):  # histogram: count/sum
+                    agg["total"] += float(value.get("count", 0))
+                    agg["sum"] = round(agg.get("sum", 0.0) + float(value.get("sum", 0.0)), 6)
+                else:
+                    agg["total"] += float(value)
+                    if ftype == "gauge":
+                        agg["min"] = min(agg.get("min", float(value)), float(value))
+                        agg["max"] = max(agg.get("max", float(value)), float(value))
+    for agg in totals.values():
+        agg["total"] = round(agg["total"], 6)
+    return {"num_peers": len(records), "metrics": totals, "peers": peers}
+
+
+class SwarmMonitor:
+    """Fetch + aggregate on demand, optionally appending each view to a
+    :class:`~hivemind_tpu.utils.profiling.JsonlMetricsSink`."""
+
+    def __init__(self, dht, key: str = DEFAULT_TELEMETRY_KEY, sink=None):
+        self.dht = dht
+        self.key = key
+        self.sink = sink
+
+    def poll(self) -> Dict[str, Any]:
+        view = aggregate_swarm_view(fetch_swarm_telemetry(self.dht, self.key))
+        view["time"] = round(time.time(), 3)
+        if self.sink is not None:
+            try:
+                self.sink.log({"swarm_telemetry": view})
+            except Exception as e:
+                logger.debug(f"telemetry sink write failed: {e!r}")
+        return view
+
+    def render_report(self, view: Optional[Dict[str, Any]] = None) -> str:
+        """Human-readable one-screen summary for log lines / CLIs."""
+        view = view if view is not None else self.poll()
+        lines = [f"swarm telemetry: {view['num_peers']} peers"]
+        for name, agg in sorted(view.get("metrics", {}).items()):
+            extra = ""
+            if "sum" in agg:
+                extra = f", sum={agg['sum']:.3f}s"
+            if "min" in agg and agg.get("min") != agg.get("max"):
+                extra += f", min={agg['min']}, max={agg['max']}"
+            lines.append(f"  {name} [{agg['type']}] total={agg['total']}{extra} ({agg['peers']} peers)")
+        for peer, health in sorted(view.get("peers", {}).items()):
+            lines.append(f"  peer {peer[:16]}…: {health}")
+        return "\n".join(lines)

@@ -1,0 +1,607 @@
+"""A minimal drop-in for the subset of the ``cryptography`` package this codebase
+uses, backed by the system's libcrypto (OpenSSL >= 1.1.1) over ctypes.
+
+Some deployment images ship no ``cryptography`` wheel (no Rust toolchain, hermetic
+python), but every one of them has OpenSSL's libcrypto — the native relay daemon
+already dlopens it for the very same primitives (native/relay_daemon.cpp,
+relay_crypto::load). The import sites gate on ``cryptography`` first and fall back
+here, so behavior is identical wherever the real package exists.
+
+Covered surface (exactly what utils/crypto.py, p2p/crypto_channel.py and
+p2p/relay.py touch):
+
+- ``exceptions.InvalidSignature`` / ``exceptions.InvalidTag``
+- ``ed25519.Ed25519PrivateKey`` / ``Ed25519PublicKey`` (raw bytes, sign/verify)
+- ``x25519.X25519PrivateKey`` / ``X25519PublicKey`` (raw bytes, exchange)
+- ``ChaCha20Poly1305`` AEAD (RFC 7539: ciphertext || 16-byte tag)
+- ``HKDF`` (SHA-256; pure hmac/hashlib — no libcrypto needed)
+- ``rsa`` 2048 keygen + PSS-SHA256 sign/verify, DER (PKCS8 / SubjectPublicKeyInfo)
+- the ``hashes`` / ``serialization`` / ``padding`` marker namespaces those calls
+  pass around (Encoding.Raw etc. are accepted and validated loosely)
+
+Everything is one-shot EVP with a per-call context, so the shim is thread-safe the
+same way the real package is.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import hashlib
+import hmac as _hmac
+from typing import Optional
+
+
+class InvalidSignature(Exception):
+    pass
+
+
+class InvalidTag(Exception):
+    pass
+
+
+class _Exceptions:
+    InvalidSignature = InvalidSignature
+    InvalidTag = InvalidTag
+
+
+exceptions = _Exceptions()
+
+# ------------------------------------------------------------------ libcrypto
+
+
+def _load_libcrypto() -> ctypes.CDLL:
+    candidates = []
+    found = ctypes.util.find_library("crypto")
+    if found:
+        candidates.append(found)
+    candidates += ["libcrypto.so.3", "libcrypto.so.1.1", "libcrypto.so", "libcrypto.dylib"]
+    last_error: Optional[Exception] = None
+    for name in candidates:
+        try:
+            lib = ctypes.CDLL(name)
+            lib.EVP_PKEY_new_raw_private_key  # >= 1.1.1 required (Ed25519 raw keys)
+            return lib
+        except (OSError, AttributeError) as e:
+            last_error = e
+    raise ImportError(
+        f"neither the 'cryptography' package nor a usable libcrypto (OpenSSL >= 1.1.1) "
+        f"is available: {last_error!r}"
+    )
+
+
+_lib = _load_libcrypto()
+
+_lib.EVP_PKEY_new_raw_private_key.restype = ctypes.c_void_p
+_lib.EVP_PKEY_new_raw_private_key.argtypes = [ctypes.c_int, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+_lib.EVP_PKEY_new_raw_public_key.restype = ctypes.c_void_p
+_lib.EVP_PKEY_new_raw_public_key.argtypes = [ctypes.c_int, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+_lib.EVP_PKEY_get_raw_private_key.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_size_t)]
+_lib.EVP_PKEY_get_raw_public_key.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_size_t)]
+_lib.EVP_PKEY_free.argtypes = [ctypes.c_void_p]
+_lib.EVP_PKEY_CTX_new_id.restype = ctypes.c_void_p
+_lib.EVP_PKEY_CTX_new_id.argtypes = [ctypes.c_int, ctypes.c_void_p]
+_lib.EVP_PKEY_CTX_new.restype = ctypes.c_void_p
+_lib.EVP_PKEY_CTX_new.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+_lib.EVP_PKEY_CTX_free.argtypes = [ctypes.c_void_p]
+_lib.EVP_PKEY_keygen_init.argtypes = [ctypes.c_void_p]
+_lib.EVP_PKEY_keygen.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p)]
+_lib.EVP_PKEY_CTX_ctrl_str.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p]
+_lib.EVP_PKEY_derive_init.argtypes = [ctypes.c_void_p]
+_lib.EVP_PKEY_derive_set_peer.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+_lib.EVP_PKEY_derive.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_size_t)]
+_lib.EVP_MD_CTX_new.restype = ctypes.c_void_p
+_lib.EVP_MD_CTX_free.argtypes = [ctypes.c_void_p]
+_lib.EVP_DigestSignInit.argtypes = [
+    ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+]
+_lib.EVP_DigestVerifyInit.argtypes = [
+    ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+]
+_lib.EVP_DigestSign.argtypes = [
+    ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_size_t), ctypes.c_char_p, ctypes.c_size_t,
+]
+_lib.EVP_DigestVerify.argtypes = [
+    ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+]
+_lib.EVP_sha256.restype = ctypes.c_void_p
+_lib.EVP_CIPHER_CTX_new.restype = ctypes.c_void_p
+_lib.EVP_CIPHER_CTX_free.argtypes = [ctypes.c_void_p]
+_lib.EVP_chacha20_poly1305.restype = ctypes.c_void_p
+_lib.EVP_CipherInit_ex.argtypes = [
+    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+]
+_lib.EVP_CipherUpdate.argtypes = [
+    ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int), ctypes.c_char_p, ctypes.c_int,
+]
+_lib.EVP_CipherFinal_ex.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int)]
+_lib.EVP_CIPHER_CTX_ctrl.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_void_p]
+_lib.EVP_PKEY2PKCS8.restype = ctypes.c_void_p
+_lib.EVP_PKEY2PKCS8.argtypes = [ctypes.c_void_p]
+_lib.PKCS8_PRIV_KEY_INFO_free.argtypes = [ctypes.c_void_p]
+_lib.i2d_PKCS8_PRIV_KEY_INFO.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte))]
+_lib.d2i_PKCS8_PRIV_KEY_INFO.restype = ctypes.c_void_p
+_lib.d2i_PKCS8_PRIV_KEY_INFO.argtypes = [
+    ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)), ctypes.c_long,
+]
+_lib.EVP_PKCS82PKEY.restype = ctypes.c_void_p
+_lib.EVP_PKCS82PKEY.argtypes = [ctypes.c_void_p]
+_lib.i2d_PUBKEY.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte))]
+_lib.d2i_PUBKEY.restype = ctypes.c_void_p
+_lib.d2i_PUBKEY.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)), ctypes.c_long]
+# OPENSSL_free is a macro over CRYPTO_free(ptr, file, line)
+_lib.CRYPTO_free.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+_lib.CRYPTO_free.restype = None
+
+
+def _openssl_free(ptr) -> None:
+    _lib.CRYPTO_free(ptr, b"_libcrypto.py", 0)
+
+_EVP_PKEY_X25519 = 1034  # NID_X25519
+_EVP_PKEY_ED25519 = 1087  # NID_ED25519
+_EVP_PKEY_RSA = 6
+_EVP_CTRL_AEAD_SET_IVLEN = 0x9
+_EVP_CTRL_AEAD_GET_TAG = 0x10
+_EVP_CTRL_AEAD_SET_TAG = 0x11
+
+
+def _check(ok: int, what: str) -> None:
+    if ok != 1:
+        raise ValueError(f"libcrypto: {what} failed")
+
+
+class _PKey:
+    """Owns one EVP_PKEY*."""
+
+    def __init__(self, handle: int):
+        if not handle:
+            raise ValueError("libcrypto returned a NULL EVP_PKEY")
+        self._handle = handle
+
+    def __del__(self):
+        handle, self._handle = getattr(self, "_handle", None), None
+        if handle:
+            _lib.EVP_PKEY_free(handle)
+
+
+def _keygen(key_type: int, setup=None) -> _PKey:
+    ctx = _lib.EVP_PKEY_CTX_new_id(key_type, None)
+    if not ctx:
+        raise ValueError(f"libcrypto: no keygen context for type {key_type}")
+    try:
+        _check(_lib.EVP_PKEY_keygen_init(ctx), "keygen_init")
+        if setup is not None:
+            setup(ctx)
+        out = ctypes.c_void_p()
+        _check(_lib.EVP_PKEY_keygen(ctx, ctypes.byref(out)), "keygen")
+        return _PKey(out.value)
+    finally:
+        _lib.EVP_PKEY_CTX_free(ctx)
+
+
+def _raw_private(pkey: _PKey, length: int = 32) -> bytes:
+    buf = ctypes.create_string_buffer(length)
+    size = ctypes.c_size_t(length)
+    _check(_lib.EVP_PKEY_get_raw_private_key(pkey._handle, buf, ctypes.byref(size)), "get_raw_private_key")
+    return buf.raw[: size.value]
+
+
+def _raw_public(pkey: _PKey, length: int = 32) -> bytes:
+    buf = ctypes.create_string_buffer(length)
+    size = ctypes.c_size_t(length)
+    _check(_lib.EVP_PKEY_get_raw_public_key(pkey._handle, buf, ctypes.byref(size)), "get_raw_public_key")
+    return buf.raw[: size.value]
+
+
+# ------------------------------------------------------------------ marker namespaces
+
+
+class _SHA256Marker:
+    digest_size = 32
+
+
+class _Hashes:
+    SHA256 = _SHA256Marker
+
+
+hashes = _Hashes()
+
+
+class _Encoding:
+    Raw = "Raw"
+    DER = "DER"
+
+
+class _PrivateFormat:
+    Raw = "Raw"
+    PKCS8 = "PKCS8"
+
+
+class _PublicFormat:
+    Raw = "Raw"
+    SubjectPublicKeyInfo = "SubjectPublicKeyInfo"
+
+
+class _NoEncryption:
+    pass
+
+
+class _Serialization:
+    Encoding = _Encoding
+    PrivateFormat = _PrivateFormat
+    PublicFormat = _PublicFormat
+    NoEncryption = _NoEncryption
+
+    @staticmethod
+    def load_der_private_key(data: bytes, password=None):
+        assert password is None, "encrypted keys are not supported by the libcrypto shim"
+        return RSAPrivateKey._from_der(data)
+
+    @staticmethod
+    def load_der_public_key(data: bytes):
+        return RSAPublicKey._from_der(data)
+
+
+serialization = _Serialization()
+
+
+# ------------------------------------------------------------------ Ed25519
+
+
+class Ed25519PrivateKey:
+    def __init__(self, pkey: _PKey):
+        self._pkey = pkey
+
+    @classmethod
+    def generate(cls) -> "Ed25519PrivateKey":
+        return cls(_keygen(_EVP_PKEY_ED25519))
+
+    @classmethod
+    def from_private_bytes(cls, data: bytes) -> "Ed25519PrivateKey":
+        handle = _lib.EVP_PKEY_new_raw_private_key(_EVP_PKEY_ED25519, None, bytes(data), len(data))
+        return cls(_PKey(handle))
+
+    def sign(self, data: bytes) -> bytes:
+        mdctx = _lib.EVP_MD_CTX_new()
+        try:
+            _check(_lib.EVP_DigestSignInit(mdctx, None, None, None, self._pkey._handle), "DigestSignInit")
+            sig = ctypes.create_string_buffer(64)
+            siglen = ctypes.c_size_t(64)
+            _check(_lib.EVP_DigestSign(mdctx, sig, ctypes.byref(siglen), bytes(data), len(data)), "DigestSign")
+            return sig.raw[: siglen.value]
+        finally:
+            _lib.EVP_MD_CTX_free(mdctx)
+
+    def public_key(self) -> "Ed25519PublicKey":
+        return Ed25519PublicKey.from_public_bytes(_raw_public(self._pkey))
+
+    def private_bytes(self, encoding=None, format=None, encryption_algorithm=None) -> bytes:
+        return _raw_private(self._pkey)
+
+    def private_bytes_raw(self) -> bytes:
+        return _raw_private(self._pkey)
+
+
+class Ed25519PublicKey:
+    def __init__(self, pkey: _PKey):
+        self._pkey = pkey
+
+    @classmethod
+    def from_public_bytes(cls, data: bytes) -> "Ed25519PublicKey":
+        handle = _lib.EVP_PKEY_new_raw_public_key(_EVP_PKEY_ED25519, None, bytes(data), len(data))
+        return cls(_PKey(handle))
+
+    def verify(self, signature: bytes, data: bytes) -> None:
+        mdctx = _lib.EVP_MD_CTX_new()
+        try:
+            _check(_lib.EVP_DigestVerifyInit(mdctx, None, None, None, self._pkey._handle), "DigestVerifyInit")
+            ok = _lib.EVP_DigestVerify(mdctx, bytes(signature), len(signature), bytes(data), len(data))
+        finally:
+            _lib.EVP_MD_CTX_free(mdctx)
+        if ok != 1:
+            raise InvalidSignature("Ed25519 signature mismatch")
+
+    def public_bytes(self, encoding=None, format=None) -> bytes:
+        return _raw_public(self._pkey)
+
+    def public_bytes_raw(self) -> bytes:
+        return _raw_public(self._pkey)
+
+
+class _Ed25519Module:
+    Ed25519PrivateKey = Ed25519PrivateKey
+    Ed25519PublicKey = Ed25519PublicKey
+
+
+ed25519 = _Ed25519Module()
+
+
+# ------------------------------------------------------------------ X25519
+
+
+class X25519PublicKey:
+    def __init__(self, pkey: _PKey):
+        self._pkey = pkey
+
+    @classmethod
+    def from_public_bytes(cls, data: bytes) -> "X25519PublicKey":
+        handle = _lib.EVP_PKEY_new_raw_public_key(_EVP_PKEY_X25519, None, bytes(data), len(data))
+        return cls(_PKey(handle))
+
+    def public_bytes(self, encoding=None, format=None) -> bytes:
+        return _raw_public(self._pkey)
+
+    def public_bytes_raw(self) -> bytes:
+        return _raw_public(self._pkey)
+
+
+class X25519PrivateKey:
+    def __init__(self, pkey: _PKey):
+        self._pkey = pkey
+
+    @classmethod
+    def generate(cls) -> "X25519PrivateKey":
+        return cls(_keygen(_EVP_PKEY_X25519))
+
+    @classmethod
+    def from_private_bytes(cls, data: bytes) -> "X25519PrivateKey":
+        handle = _lib.EVP_PKEY_new_raw_private_key(_EVP_PKEY_X25519, None, bytes(data), len(data))
+        return cls(_PKey(handle))
+
+    def public_key(self) -> X25519PublicKey:
+        return X25519PublicKey.from_public_bytes(_raw_public(self._pkey))
+
+    def exchange(self, peer_public_key: X25519PublicKey) -> bytes:
+        ctx = _lib.EVP_PKEY_CTX_new(self._pkey._handle, None)
+        if not ctx:
+            raise ValueError("libcrypto: no derive context")
+        try:
+            _check(_lib.EVP_PKEY_derive_init(ctx), "derive_init")
+            _check(_lib.EVP_PKEY_derive_set_peer(ctx, peer_public_key._pkey._handle), "derive_set_peer")
+            out = ctypes.create_string_buffer(32)
+            outlen = ctypes.c_size_t(32)
+            _check(_lib.EVP_PKEY_derive(ctx, out, ctypes.byref(outlen)), "derive")
+            return out.raw[: outlen.value]
+        finally:
+            _lib.EVP_PKEY_CTX_free(ctx)
+
+
+class _X25519Module:
+    X25519PrivateKey = X25519PrivateKey
+    X25519PublicKey = X25519PublicKey
+
+
+x25519 = _X25519Module()
+
+
+# ------------------------------------------------------------------ ChaCha20-Poly1305
+
+
+class ChaCha20Poly1305:
+    _TAG_LEN = 16
+
+    def __init__(self, key: bytes):
+        if len(key) != 32:
+            raise ValueError("ChaCha20Poly1305 key must be 32 bytes")
+        self._key = bytes(key)
+
+    def _run(self, encrypt: bool, nonce: bytes, data: bytes, aad: Optional[bytes], tag: Optional[bytes]):
+        ctx = _lib.EVP_CIPHER_CTX_new()
+        if not ctx:
+            raise ValueError("libcrypto: no cipher context")
+        try:
+            enc = 1 if encrypt else 0
+            _check(_lib.EVP_CipherInit_ex(ctx, _lib.EVP_chacha20_poly1305(), None, None, None, enc), "CipherInit")
+            _check(
+                _lib.EVP_CIPHER_CTX_ctrl(ctx, _EVP_CTRL_AEAD_SET_IVLEN, len(nonce), None), "set_ivlen"
+            )
+            _check(_lib.EVP_CipherInit_ex(ctx, None, None, self._key, bytes(nonce), enc), "CipherInit(key)")
+            outlen = ctypes.c_int(0)
+            if aad:
+                _check(_lib.EVP_CipherUpdate(ctx, None, ctypes.byref(outlen), bytes(aad), len(aad)), "aad")
+            out = ctypes.create_string_buffer(len(data) if data else 1)
+            total = 0
+            if data:
+                _check(_lib.EVP_CipherUpdate(ctx, out, ctypes.byref(outlen), bytes(data), len(data)), "update")
+                total = outlen.value
+            if not encrypt:
+                tag_buf = ctypes.create_string_buffer(bytes(tag), self._TAG_LEN)
+                _check(_lib.EVP_CIPHER_CTX_ctrl(ctx, _EVP_CTRL_AEAD_SET_TAG, self._TAG_LEN, tag_buf), "set_tag")
+            final = ctypes.create_string_buffer(16)
+            ok = _lib.EVP_CipherFinal_ex(ctx, final, ctypes.byref(outlen))
+            if ok != 1:
+                raise InvalidTag("AEAD authentication failed")
+            result = out.raw[:total]
+            if encrypt:
+                tag_out = ctypes.create_string_buffer(self._TAG_LEN)
+                _check(_lib.EVP_CIPHER_CTX_ctrl(ctx, _EVP_CTRL_AEAD_GET_TAG, self._TAG_LEN, tag_out), "get_tag")
+                return result + tag_out.raw
+            return result
+        finally:
+            _lib.EVP_CIPHER_CTX_free(ctx)
+
+    def encrypt(self, nonce: bytes, data: bytes, associated_data: Optional[bytes]) -> bytes:
+        return self._run(True, nonce, data, associated_data, None)
+
+    def decrypt(self, nonce: bytes, data: bytes, associated_data: Optional[bytes]) -> bytes:
+        if len(data) < self._TAG_LEN:
+            raise InvalidTag("ciphertext shorter than the AEAD tag")
+        return self._run(False, nonce, data[: -self._TAG_LEN], associated_data, data[-self._TAG_LEN :])
+
+
+# ------------------------------------------------------------------ HKDF (RFC 5869, SHA-256)
+
+
+class HKDF:
+    def __init__(self, algorithm=None, length: int = 32, salt: Optional[bytes] = None, info: Optional[bytes] = None):
+        self._length = length
+        self._salt = salt or b"\x00" * 32
+        self._info = info or b""
+        self._used = False
+
+    def derive(self, key_material: bytes) -> bytes:
+        assert not self._used, "HKDF instances are single-use"
+        self._used = True
+        prk = _hmac.new(self._salt, bytes(key_material), hashlib.sha256).digest()
+        okm, block = b"", b""
+        counter = 1
+        while len(okm) < self._length:
+            block = _hmac.new(prk, block + self._info + bytes([counter]), hashlib.sha256).digest()
+            okm += block
+            counter += 1
+        return okm[: self._length]
+
+
+# ------------------------------------------------------------------ RSA (PSS-SHA256, DER)
+
+
+class _PSSMarker:
+    MAX_LENGTH = "max"
+
+    def __init__(self, mgf=None, salt_length=None):
+        pass
+
+
+class _MGF1Marker:
+    def __init__(self, algorithm=None):
+        pass
+
+
+class _Padding:
+    PSS = _PSSMarker
+    MGF1 = _MGF1Marker
+
+
+padding = _Padding()
+
+
+def _rsa_pss_ctrl(pctx_value: int, sign: bool) -> None:
+    pctx = ctypes.c_void_p(pctx_value)
+    _check(_lib.EVP_PKEY_CTX_ctrl_str(pctx, b"rsa_padding_mode", b"pss"), "rsa_padding_mode")
+    _check(
+        _lib.EVP_PKEY_CTX_ctrl_str(pctx, b"rsa_pss_saltlen", b"max" if sign else b"auto"),
+        "rsa_pss_saltlen",
+    )
+
+
+class RSAPrivateKey:
+    def __init__(self, pkey: _PKey):
+        self._pkey = pkey
+
+    @classmethod
+    def _from_der(cls, data: bytes) -> "RSAPrivateKey":
+        raw = (ctypes.c_ubyte * len(data)).from_buffer_copy(data)
+        pp = ctypes.cast(raw, ctypes.POINTER(ctypes.c_ubyte))
+        p8 = _lib.d2i_PKCS8_PRIV_KEY_INFO(None, ctypes.byref(pp), len(data))
+        if not p8:
+            raise ValueError("could not parse PKCS8 private key DER")
+        try:
+            handle = _lib.EVP_PKCS82PKEY(p8)
+        finally:
+            _lib.PKCS8_PRIV_KEY_INFO_free(p8)
+        return cls(_PKey(handle))
+
+    def sign(self, data: bytes, pss_padding=None, algorithm=None) -> bytes:
+        mdctx = _lib.EVP_MD_CTX_new()
+        try:
+            pctx = ctypes.c_void_p()
+            _check(
+                _lib.EVP_DigestSignInit(mdctx, ctypes.byref(pctx), _lib.EVP_sha256(), None, self._pkey._handle),
+                "DigestSignInit(RSA)",
+            )
+            _rsa_pss_ctrl(pctx.value, sign=True)
+            siglen = ctypes.c_size_t(0)
+            _check(_lib.EVP_DigestSign(mdctx, None, ctypes.byref(siglen), bytes(data), len(data)), "size")
+            sig = ctypes.create_string_buffer(siglen.value)
+            _check(_lib.EVP_DigestSign(mdctx, sig, ctypes.byref(siglen), bytes(data), len(data)), "DigestSign(RSA)")
+            return sig.raw[: siglen.value]
+        finally:
+            _lib.EVP_MD_CTX_free(mdctx)
+
+    def public_key(self) -> "RSAPublicKey":
+        der = self.public_key_der()
+        return RSAPublicKey._from_der(der)
+
+    def public_key_der(self) -> bytes:
+        out = ctypes.POINTER(ctypes.c_ubyte)()
+        length = _lib.i2d_PUBKEY(self._pkey._handle, ctypes.byref(out))
+        if length <= 0:
+            raise ValueError("i2d_PUBKEY failed")
+        try:
+            return bytes(bytearray(out[:length]))
+        finally:
+            _openssl_free(out)
+
+    def private_bytes(self, encoding=None, format=None, encryption_algorithm=None) -> bytes:
+        p8 = _lib.EVP_PKEY2PKCS8(self._pkey._handle)
+        if not p8:
+            raise ValueError("EVP_PKEY2PKCS8 failed")
+        try:
+            out = ctypes.POINTER(ctypes.c_ubyte)()
+            length = _lib.i2d_PKCS8_PRIV_KEY_INFO(p8, ctypes.byref(out))
+            if length <= 0:
+                raise ValueError("i2d_PKCS8_PRIV_KEY_INFO failed")
+            try:
+                return bytes(bytearray(out[:length]))
+            finally:
+                _openssl_free(out)
+        finally:
+            _lib.PKCS8_PRIV_KEY_INFO_free(p8)
+
+
+class RSAPublicKey:
+    def __init__(self, pkey: _PKey):
+        self._pkey = pkey
+
+    @classmethod
+    def _from_der(cls, data: bytes) -> "RSAPublicKey":
+        raw = (ctypes.c_ubyte * len(data)).from_buffer_copy(data)
+        pp = ctypes.cast(raw, ctypes.POINTER(ctypes.c_ubyte))
+        handle = _lib.d2i_PUBKEY(None, ctypes.byref(pp), len(data))
+        if not handle:
+            raise ValueError("could not parse SubjectPublicKeyInfo DER")
+        return cls(_PKey(handle))
+
+    def verify(self, signature: bytes, data: bytes, pss_padding=None, algorithm=None) -> None:
+        mdctx = _lib.EVP_MD_CTX_new()
+        try:
+            pctx = ctypes.c_void_p()
+            _check(
+                _lib.EVP_DigestVerifyInit(mdctx, ctypes.byref(pctx), _lib.EVP_sha256(), None, self._pkey._handle),
+                "DigestVerifyInit(RSA)",
+            )
+            _rsa_pss_ctrl(pctx.value, sign=False)
+            ok = _lib.EVP_DigestVerify(mdctx, bytes(signature), len(signature), bytes(data), len(data))
+        finally:
+            _lib.EVP_MD_CTX_free(mdctx)
+        if ok != 1:
+            raise InvalidSignature("RSA-PSS signature mismatch")
+
+    def public_bytes(self, encoding=None, format=None) -> bytes:
+        out = ctypes.POINTER(ctypes.c_ubyte)()
+        length = _lib.i2d_PUBKEY(self._pkey._handle, ctypes.byref(out))
+        if length <= 0:
+            raise ValueError("i2d_PUBKEY failed")
+        try:
+            return bytes(bytearray(out[:length]))
+        finally:
+            _openssl_free(out)
+
+
+def _rsa_generate_private_key(public_exponent: int = 65537, key_size: int = 2048) -> RSAPrivateKey:
+    def _setup(ctx):
+        _check(
+            _lib.EVP_PKEY_CTX_ctrl_str(ctypes.c_void_p(ctx), b"rsa_keygen_bits", str(key_size).encode()),
+            "rsa_keygen_bits",
+        )
+
+    return RSAPrivateKey(_keygen(_EVP_PKEY_RSA, _setup))
+
+
+class _RSAModule:
+    RSAPrivateKey = RSAPrivateKey
+    RSAPublicKey = RSAPublicKey
+    generate_private_key = staticmethod(_rsa_generate_private_key)
+
+
+rsa = _RSAModule()

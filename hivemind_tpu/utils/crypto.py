@@ -13,9 +13,16 @@ import threading
 from abc import ABC, abstractmethod
 from typing import Optional
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ed25519, padding, rsa
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ed25519, padding, rsa
+except ImportError:  # no cryptography wheel on this image: system libcrypto shim
+    from hivemind_tpu.utils import _libcrypto as _compat
+
+    InvalidSignature = _compat.InvalidSignature
+    hashes, serialization = _compat.hashes, _compat.serialization
+    ed25519, padding, rsa = _compat.ed25519, _compat.padding, _compat.rsa
 
 
 class PrivateKeyBase(ABC):

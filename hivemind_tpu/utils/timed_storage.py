@@ -23,9 +23,13 @@ def get_dht_time() -> DHTExpiration:
     return time.time()
 
 
-class ValueWithExpiration(NamedTuple, Generic[ValueType]):
-    value: ValueType
+class ValueWithExpiration(NamedTuple):
+    # generic-NamedTuple multiple inheritance requires py3.11; on 3.10 the class
+    # stays a plain NamedTuple and subscription (ValueWithExpiration[T]) is a no-op
+    value: "ValueType"  # type: ignore[valid-type]
     expiration_time: DHTExpiration
+
+    __class_getitem__ = classmethod(lambda cls, _item: cls)  # type: ignore[assignment]
 
     def __eq__(self, other):
         if isinstance(other, ValueWithExpiration):
@@ -41,9 +45,11 @@ class ValueWithExpiration(NamedTuple, Generic[ValueType]):
         return hash((self.value, self.expiration_time))
 
 
-class _HeapEntry(NamedTuple, Generic[KeyType]):
+class _HeapEntry(NamedTuple):
     expiration_time: DHTExpiration
-    key: KeyType
+    key: "KeyType"  # type: ignore[valid-type]
+
+    __class_getitem__ = classmethod(lambda cls, _item: cls)  # type: ignore[assignment]
 
 
 class TimedStorage(Generic[KeyType, ValueType]):

@@ -100,6 +100,46 @@ def test_compact_line_bounded_even_when_pathological():
     assert parsed["value"] == 1234.5
 
 
+def test_bench_artifact_embeds_telemetry_snapshot():
+    """ISSUE 2: every BENCH artifact carries a telemetry snapshot — the bench
+    process's registry plus the averaging swarm's (shipped via its JSON extra) —
+    while the compact stdout line stays bounded."""
+    from hivemind_tpu.telemetry import REGISTRY
+
+    REGISTRY.counter("bench_emission_probe_total", "test counter").inc(5)
+    averaging = {
+        "value": 0.61,
+        "extra": {"telemetry": {"hivemind_averaging_matchmaking_rounds_total": {
+            "type": "counter", "series": {"outcome=assembled": 8}}}},
+    }
+    try:
+        section = bench.telemetry_section(averaging)
+    finally:
+        REGISTRY.unregister("bench_emission_probe_total")  # keep the global registry clean
+    assert section["bench_process"]["metrics"]["bench_emission_probe_total"]["series"]["_"] == 5
+    assert section["averaging_swarm"]["hivemind_averaging_matchmaking_rounds_total"]["series"][
+        "outcome=assembled"] == 8
+
+    result = _bloated_result()
+    result["telemetry"] = section
+    out, err = io.StringIO(), io.StringIO()
+    bench.emit(result, out=out, err=err)
+    # the full stderr artifact carries the snapshot verbatim…
+    full = json.loads(err.getvalue())
+    assert full["telemetry"]["bench_process"]["metrics"]["bench_emission_probe_total"]
+    assert full["telemetry"]["averaging_swarm"]
+    # …and the compact driver line still fits and leads with the metric
+    last_line = out.getvalue().strip().splitlines()[-1]
+    assert len(last_line) <= 1500
+    assert json.loads(last_line)["metric"] == "albert_base_mlm_tokens_per_sec_per_chip"
+
+
+def test_telemetry_section_survives_missing_averaging():
+    section = bench.telemetry_section(None)
+    assert "bench_process" in section or "error" in section
+    assert "averaging_swarm" not in section
+
+
 def test_compact_line_keeps_tpu_success_fields():
     result = {
         "metric": "albert_base_mlm_tokens_per_sec_per_chip",

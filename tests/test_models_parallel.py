@@ -56,7 +56,7 @@ def test_ring_attention_matches_plain():
     expected = plain_attention(q, k, v)
 
     from functools import partial
-    from jax import shard_map
+    from hivemind_tpu.parallel._compat import shard_map
 
     spec = P(None, "sp", None, None)
     ring = shard_map(
@@ -73,7 +73,7 @@ def test_causal_ring_attention_matches_plain():
     attention: past shards contribute fully, the local shard causally, future shards
     not at all."""
     from functools import partial
-    from jax import shard_map
+    from hivemind_tpu.parallel._compat import shard_map
 
     mesh = make_mesh(dp=1, tp=1, sp=4)
     batch, seq, heads, dim = 2, 32, 4, 8
@@ -134,8 +134,8 @@ def test_ring_flash_attention_matches_plain():
     interpret mode on CPU) must reproduce single-device attention, and its
     recompute-backward must match plain attention's gradients."""
     from functools import partial
-    from jax import shard_map
 
+    from hivemind_tpu.parallel._compat import NO_CHECK as no_check, shard_map
     from hivemind_tpu.parallel.ring_attention import ring_flash_attention
 
     mesh = make_mesh(dp=1, tp=1, sp=4)
@@ -151,7 +151,7 @@ def test_ring_flash_attention_matches_plain():
     ring = shard_map(
         partial(ring_flash_attention, axis_name="sp", interpret=True),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,  # the vma checker can't see through pallas_call outputs
+        **no_check,  # the vma/rep checker can't see through pallas_call outputs
     )
     with mesh:
         result = jax.jit(ring)(q, k, v)
@@ -172,7 +172,7 @@ def test_ring_flash_attention_matches_plain():
     causal_ring = shard_map(
         partial(ring_flash_attention, axis_name="sp", interpret=True, causal=True),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
+        **no_check,
     )
     with mesh:
         causal_result = jax.jit(causal_ring)(q, k, v)
