@@ -47,6 +47,10 @@ def __getattr__(name):  # lazy top-level API so `import hivemind_tpu` stays ligh
         "RemoteSequential": "hivemind_tpu.moe",
         "RemoteSwitchMixtureOfExperts": "hivemind_tpu.moe",
         "register_expert_class": "hivemind_tpu.moe",
+        "RetryPolicy": "hivemind_tpu.resilience",
+        "Deadline": "hivemind_tpu.resilience",
+        "BreakerBoard": "hivemind_tpu.resilience",
+        "CHAOS": "hivemind_tpu.resilience",
     }
     if name in top_level:
         module = importlib.import_module(top_level[name])

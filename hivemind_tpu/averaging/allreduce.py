@@ -25,6 +25,8 @@ from hivemind_tpu.averaging.partition import (
 from hivemind_tpu.compression import CompressionBase, NoCompression, deserialize_tensor, serialize_tensor
 from hivemind_tpu.p2p import P2P, P2PContext, PeerID
 from hivemind_tpu.proto import averaging_pb2
+from hivemind_tpu.resilience import CHAOS as _CHAOS
+from hivemind_tpu.resilience import BreakerBoard
 from hivemind_tpu.utils.asyncio_utils import aiter_with_timeout
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.timed_storage import get_dht_time
@@ -119,7 +121,12 @@ class AllReduceRunner:
         self.reducer = TensorPartReducer(my_part_shapes, self.num_senders)
         self.compression = compression
         self.part_size_bytes = part_size_bytes
-        self.banned_senders: set = set()
+        # sender bans are the degenerate case of the shared cross-layer breaker
+        # (resilience/breaker.py): threshold 1, infinite recovery — tripped once,
+        # banned for the round's lifetime. `rank in banned_senders` still works.
+        self.banned_senders = BreakerBoard(
+            "allreduce_senders", failure_threshold=1, recovery_time=float("inf")
+        )
         self._sender_last_active: Dict[int, float] = {}
         self._parts_received: Dict[int, int] = {}  # sender rank -> parts accepted
         self._finished = asyncio.Event()
@@ -197,6 +204,8 @@ class AllReduceRunner:
             async def _requests():
                 first = True
                 async for serialized in self.container.iterate_input_parts_for(peer_index):
+                    if _CHAOS.enabled:  # injection point: per part shipped to a reducer
+                        await _CHAOS.inject("allreduce.load", scope=str(self.p2p.peer_id))
                     yield averaging_pb2.AveragingData(
                         code=averaging_pb2.PART_DATA,
                         group_id=self.group_id if first else b"",
@@ -300,6 +309,8 @@ class AllReduceRunner:
                     if averaged is None:
                         yield averaging_pb2.AveragingData(code=averaging_pb2.CANCELLED)
                         return
+                if _CHAOS.enabled:  # injection point: per delta returned to a sender
+                    await _CHAOS.inject("allreduce.reduce", scope=str(self.p2p.peer_id))
                 delta = averaged - part.astype(np.float32)
                 yield averaging_pb2.AveragingData(
                     code=averaging_pb2.PART_DATA,
@@ -314,6 +325,14 @@ class AllReduceRunner:
             self._ban_sender(sender_rank, str(e))
             yield averaging_pb2.AveragingData(code=averaging_pb2.INTERNAL_ERROR)
             return
+        except Exception as e:
+            # ANY unexpected reducer failure must release this sender's pending
+            # parts: without the ban, other parts of our span wait forever for a
+            # contribution this stream will never finish (found by the chaos
+            # engine's abort injection at allreduce.reduce — the old test-local
+            # fault subclasses always surfaced as GeneratorExit and hid this)
+            self._ban_sender(sender_rank, f"reducer error: {e!r}", cause="internal_error")
+            raise
         finally:
             reader_task.cancel()
         if part_index < len(self.reducer.part_shapes):
@@ -325,7 +344,7 @@ class AllReduceRunner:
         if sender_rank not in self.banned_senders:
             logger.debug(f"banning sender {sender_rank}: {reason}")
             _BANNED_SENDERS.inc(cause=cause)
-            self.banned_senders.add(sender_rank)
+            self.banned_senders.register_failure(sender_rank)  # trips permanently
             self.reducer.on_sender_failed(sender_rank)
 
     def _fail_laggards(self, part_index: int) -> None:

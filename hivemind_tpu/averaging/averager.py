@@ -37,6 +37,8 @@ from hivemind_tpu.compression.base import as_numpy
 from hivemind_tpu.dht import DHT
 from hivemind_tpu.p2p import P2P, P2PContext, PeerID, ServicerBase
 from hivemind_tpu.proto import averaging_pb2, runtime_pb2
+from hivemind_tpu.resilience import CHAOS as _CHAOS
+from hivemind_tpu.resilience import Deadline, RetryPolicy
 from hivemind_tpu.utils.asyncio_utils import anext_safe, enter_asynchronously
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.loop import LoopRunner, get_loop_runner
@@ -46,6 +48,23 @@ from hivemind_tpu.utils.timed_storage import DHTExpiration, ValueWithExpiration,
 logger = get_logger(__name__)
 
 GatheredData = Dict[PeerID, Any]
+
+# layer-3 telemetry (docs/observability.md + ISSUE 3 satellite): internal errors
+# this module used to swallow silently, now logged AND counted by site
+from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
+
+_AVERAGER_INTERNAL_ERRORS = _TELEMETRY.counter(
+    "hivemind_averaging_internal_errors_total",
+    "errors in averager plumbing that do not fail a step",
+    ("site",),
+)
+
+# retry pacing for failed averaging attempts: base 1.6 with equal jitter yields
+# exactly the historical U(0.8, 1.6) window multiplier, but through the shared
+# policy (resilience/policy.py) so the backoff shape is declared, not hand-rolled
+_STEP_RETRY = RetryPolicy(
+    max_attempts=None, base_delay=1.6, backoff=1.0, jitter="equal", name="averager_step"
+)
 
 
 class DecentralizedAverager(ServicerBase):
@@ -200,11 +219,19 @@ class DecentralizedAverager(ServicerBase):
         coro = _teardown()
         try:
             future = self._runner.run_coroutine(coro, return_future=True)
-        except Exception:
+        except Exception as e:
+            # the loop is already gone (interpreter teardown / runner shut down):
+            # shutdown still succeeds, but say so — a silent pass here hid real
+            # teardown bugs for two rounds (ISSUE 3 satellite)
+            logger.warning(f"averager teardown could not be scheduled: {e!r}")
+            _AVERAGER_INTERNAL_ERRORS.inc(site="shutdown_schedule")
             coro.close()  # never scheduled: release the un-awaited coroutine cleanly
         else:
-            with contextlib.suppress(Exception):
+            try:
                 future.result(self.shutdown_timeout)
+            except Exception as e:
+                logger.warning(f"averager teardown did not finish cleanly: {e!r}")
+                _AVERAGER_INTERNAL_ERRORS.inc(site="shutdown_teardown")
 
     def __enter__(self):
         if not self._ready.is_set():
@@ -321,8 +348,9 @@ class DecentralizedAverager(ServicerBase):
                     # fresh matchmaking window with jitter: symmetric failures would
                     # otherwise re-synchronize and livelock (everyone re-declares the
                     # same deadline and nobody becomes anyone's leader)
-                    jitter = random.uniform(0.8, 1.6)
-                    control.reset_for_retry(get_dht_time() + self._suggested_lead() * jitter)
+                    control.reset_for_retry(
+                        get_dht_time() + self._suggested_lead() * _STEP_RETRY.delay(0)
+                    )
         except asyncio.CancelledError:
             control.cancel()
             raise
@@ -361,6 +389,8 @@ class DecentralizedAverager(ServicerBase):
         ]
         peer_element_counts = load_balance_peers(total_elements, reducer_bandwidths)
 
+        if _CHAOS.enabled:  # injection point: die between matchmaking and the round
+            await _CHAOS.inject("allreduce.setup", scope=str(self.peer_id))
         runner = self._make_allreduce_runner(group_info, peer_element_counts, modes, weight)
         async with self._allreduce_registered:
             self._running_allreduces[group_info.group_id] = runner
@@ -492,16 +522,12 @@ class DecentralizedAverager(ServicerBase):
             yield message
 
     async def _find_runner(self, group_id: bytes, timeout: Optional[float] = None) -> Optional[AllReduceRunner]:
-        timeout = timeout if timeout is not None else self.request_timeout * 2
-        deadline = get_dht_time() + timeout
+        budget = Deadline(timeout if timeout is not None else self.request_timeout * 2)
         async with self._allreduce_registered:
             while group_id not in self._running_allreduces:
-                remaining = deadline - get_dht_time()
-                if remaining <= 0:
-                    return None
                 try:
-                    await asyncio.wait_for(self._allreduce_registered.wait(), timeout=remaining)
-                except asyncio.TimeoutError:
+                    await budget.wait_for(self._allreduce_registered.wait())
+                except asyncio.TimeoutError:  # includes DeadlineExceeded
                     return None
             return self._running_allreduces[group_id]
 
@@ -550,7 +576,13 @@ class DecentralizedAverager(ServicerBase):
                     priority = entry.value
                     if peer_id != exclude_peer_id and isinstance(priority, (int, float, list, tuple)):
                         candidates.append((priority, random.random(), peer_id))
-                except Exception:
+                except Exception as e:
+                    # a malformed declaration record (bad base58 subkey / garbage
+                    # priority) — skipping is correct, but it must be visible: a
+                    # swarm full of these means someone is publishing junk under
+                    # our prefix (ISSUE 3 satellite: no silent swallowing)
+                    logger.warning(f"ignoring malformed averager declaration {subkey!r}: {e!r}")
+                    _AVERAGER_INTERNAL_ERRORS.inc(site="state_declaration_parse")
                     continue
         candidates.sort(reverse=True)
         for _priority, _jitter, peer_id in candidates:
@@ -619,13 +651,20 @@ class DecentralizedAverager(ServicerBase):
         key = f"{self.prefix}.all_averagers"
         while True:
             if self._allow_state_sharing:
-                with contextlib.suppress(Exception):
+                try:
                     await self.dht.node.store(
                         key,
                         value=self._state_sharing_priority,
                         expiration_time=get_dht_time() + self.declare_state_period * 2,
                         subkey=self.peer_id.to_base58(),
                     )
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    # failing to declare is survivable (peers just cannot download
+                    # state from us until the next period) but must be counted
+                    logger.warning(f"could not declare state under {key!r}: {e!r}")
+                    _AVERAGER_INTERNAL_ERRORS.inc(site="declare_state")
             await asyncio.sleep(self.declare_state_period)
 
     def get_group_bits(self) -> str:

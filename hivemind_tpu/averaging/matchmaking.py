@@ -23,6 +23,7 @@ from hivemind_tpu.averaging.group_info import GroupInfo
 from hivemind_tpu.averaging.key_manager import GroupKeyManager
 from hivemind_tpu.p2p import P2P, P2PContext, P2PHandlerError, PeerID
 from hivemind_tpu.proto import averaging_pb2
+from hivemind_tpu.resilience import RetryPolicy
 from hivemind_tpu.utils.asyncio_utils import anext_safe, cancel_and_wait
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.timed_storage import DHTExpiration, get_dht_time
@@ -78,6 +79,17 @@ class Matchmaking:
         self.request_timeout = request_timeout
         self.client_mode = client_mode
 
+        # pacing between leader-candidate polls: request_timeout/2 plus a small
+        # full-jitter slice through the shared policy (resilience/policy.py) —
+        # the historical U(rt/2, rt/2 + 0.2) desynchronization window, declared
+        self._poll_floor = request_timeout / 2
+        self._poll_policy = RetryPolicy(
+            max_attempts=None,
+            base_delay=0.2,
+            backoff=1.0,
+            jitter="full",
+            name="matchmaking_poll",
+        )
         self.lock_looking_for_group = asyncio.Lock()
         self.looking_for_group = False
         self.declared_expiration_time: DHTExpiration = -float("inf")
@@ -226,7 +238,7 @@ class Matchmaking:
                 continue
             remaining = self.declared_expiration_time - get_dht_time()
             if remaining > 0:
-                await asyncio.sleep(min(remaining, self.request_timeout / 2 + random.random() * 0.2))
+                await asyncio.sleep(min(remaining, self._poll_floor + self._poll_policy.delay(0)))
         # the group may have assembled (full-group path) during the final sleep
         if self.assembled_group is not None:
             return self.assembled_group

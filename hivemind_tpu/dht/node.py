@@ -32,6 +32,7 @@ from hivemind_tpu.dht.storage import DictionaryDHTValue
 from hivemind_tpu.dht.traverse import traverse_dht
 from hivemind_tpu.dht.validation import DHTRecord, RecordValidatorBase
 from hivemind_tpu.p2p import Multiaddr, P2P, PeerID
+from hivemind_tpu.resilience import BreakerBoard, Deadline
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.serializer import MSGPackSerializer
 from hivemind_tpu.utils.timed_storage import (
@@ -55,32 +56,24 @@ _DHT_OP_LATENCY = _TELEMETRY.histogram(
 )
 
 
-class Blacklist:
-    """Tracks unresponsive peers with exponential backoff
-    (reference node.py:897-931)."""
+class Blacklist(BreakerBoard):
+    """Tracks unresponsive peers with exponential backoff (reference
+    node.py:897-931) — now a thin parameterization of the shared cross-layer
+    :class:`~hivemind_tpu.resilience.BreakerBoard` (ISSUE 3): one failure trips
+    the peer's breaker open for ``base_time`` seconds, re-trips escalate by
+    ``backoff_rate``, and a success after the window (the half-open probe)
+    closes it. Trip/probe telemetry rides the shared breaker gauges."""
 
     def __init__(self, base_time: float = 5.0, backoff_rate: float = 2.0, maxsize: int = 10_000):
+        super().__init__(
+            "dht_blacklist",
+            maxsize=maxsize,
+            failure_threshold=1,
+            recovery_time=base_time,
+            backoff_rate=backoff_rate,
+            clock=get_dht_time,
+        )
         self.base_time, self.backoff_rate = base_time, backoff_rate
-        self.banned_peers = TimedStorage[PeerID, int](maxsize=maxsize)
-        self.ban_counter: Dict[PeerID, int] = defaultdict(int)
-
-    def register_failure(self, peer: PeerID) -> None:
-        if peer not in self.banned_peers and self.base_time > 0:
-            ban_duration = self.base_time * self.backoff_rate ** self.ban_counter[peer]
-            self.banned_peers.store(peer, self.ban_counter[peer], get_dht_time() + ban_duration)
-            self.ban_counter[peer] += 1
-
-    def register_success(self, peer: PeerID) -> None:
-        if peer in self.banned_peers:
-            del self.banned_peers[peer]
-        self.ban_counter.pop(peer, None)
-
-    def __contains__(self, peer: PeerID) -> bool:
-        return peer in self.banned_peers
-
-    def clear(self) -> None:
-        self.banned_peers = TimedStorage[PeerID, int](maxsize=self.banned_peers.maxsize)
-        self.ban_counter.clear()
 
 
 @dataclass
@@ -170,7 +163,9 @@ class DHTNode:
 
         if initial_peers:
             initial_peers = [Multiaddr.parse(m) if isinstance(m, str) else m for m in initial_peers]
-            bootstrap_deadline = get_dht_time() + (bootstrap_timeout if bootstrap_timeout is not None else wait_timeout * 10)
+            # one Deadline budget for the whole bootstrap (resilience/policy.py):
+            # stage 2's straggler wait gets whatever stage 1 left over
+            bootstrap_budget = Deadline(bootstrap_timeout if bootstrap_timeout is not None else wait_timeout * 10)
 
             async def _ping_address(maddr: Multiaddr) -> Optional[DHTID]:
                 try:
@@ -195,8 +190,7 @@ class DHTNode:
                     raise RuntimeError("DHTNode bootstrap failed: none of the initial peers responded")
             # stage 2: wait for stragglers until the deadline
             if pending:
-                remaining = max(0.0, bootstrap_deadline - get_dht_time())
-                await asyncio.wait(pending, timeout=remaining)
+                await asyncio.wait(pending, timeout=bootstrap_budget.remaining())
                 for task in pending:
                     task.cancel()
             # stage 3: self-lookup to populate the routing table

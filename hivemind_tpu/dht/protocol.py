@@ -18,6 +18,7 @@ from hivemind_tpu.dht.storage import DHTLocalStorage, DictionaryDHTValue
 from hivemind_tpu.dht.validation import DHTRecord, RecordValidatorBase
 from hivemind_tpu.p2p import P2P, P2PContext, P2PError, PeerID, ServicerBase
 from hivemind_tpu.proto import dht_pb2
+from hivemind_tpu.resilience import CHAOS as _CHAOS
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.serializer import MSGPackSerializer
 from hivemind_tpu.utils.timed_storage import (
@@ -114,6 +115,8 @@ class DHTProtocol(ServicerBase):
         protocol.py:97-162)."""
         started = time.perf_counter()
         try:
+            if _CHAOS.enabled:  # injection point: lose/delay the whole ping
+                await _CHAOS.inject("dht.rpc_ping", scope=str(self.p2p.peer_id))
             stub = self.get_stub(self.p2p, peer)
             response = await stub.rpc_ping(
                 dht_pb2.PingRequest(peer=self._make_node_info(), validate=validate),
@@ -181,6 +184,8 @@ class DHTProtocol(ServicerBase):
                 flat_in_cache.append(cached)
         started = time.perf_counter()
         try:
+            if _CHAOS.enabled:  # injection point: lose/delay the whole store
+                await _CHAOS.inject("dht.rpc_store", scope=str(self.p2p.peer_id))
             stub = self.get_stub(self.p2p, peer)
             response = await stub.rpc_store(
                 dht_pb2.StoreRequest(
@@ -248,6 +253,8 @@ class DHTProtocol(ServicerBase):
         keys = list(keys)
         started = time.perf_counter()
         try:
+            if _CHAOS.enabled:  # injection point: lose/delay the whole find
+                await _CHAOS.inject("dht.rpc_find", scope=str(self.p2p.peer_id))
             stub = self.get_stub(self.p2p, peer)
             response = await stub.rpc_find(
                 dht_pb2.FindRequest(keys=[k.to_bytes() for k in keys], peer=self._make_node_info()),

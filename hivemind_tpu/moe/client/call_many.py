@@ -25,11 +25,25 @@ import jax.numpy as jnp
 import numpy as np
 
 from hivemind_tpu.moe.client.expert import RemoteExpert
+from hivemind_tpu.resilience import CHAOS as _CHAOS
+from hivemind_tpu.resilience import BreakerBoard, BreakerOpenError
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.loop import get_loop_runner
 from hivemind_tpu.utils.timed_storage import get_dht_time
 
 logger = get_logger(__name__)
+
+# cross-call expert health (ISSUE 3): before this board, a dead expert cost every
+# batch a full forward_timeout re-probe. Two consecutive failures trip the
+# expert's breaker open for 30 s (doubling per re-trip); while open the expert is
+# skipped instantly, and the half-open probe re-admits it after recovery.
+EXPERT_BREAKERS = BreakerBoard(
+    "moe_expert",
+    failure_threshold=2,
+    recovery_time=30.0,
+    backoff_rate=2.0,
+    max_recovery_time=600.0,
+)
 
 
 class RemoteCallMany:
@@ -73,11 +87,24 @@ class RemoteCallMany:
         need_per_sample: int,
         timeout: Optional[float],
         job_uids: Sequence[str],
+        chaos_point: str = "moe.forward",
     ) -> Dict[str, List[np.ndarray]]:
         """Run one RPC per expert concurrently; return {uid: tensors} for the ones
-        that answered in time. Applies the k_min / timeout_after_k_min policy."""
+        that answered in time. Applies the k_min / timeout_after_k_min policy.
+        Per-expert circuit breakers skip known-dead experts instantly and track
+        each outcome (resilience/breaker.py)."""
+
+        async def _guarded_call(uid: str):
+            if not EXPERT_BREAKERS.allow(uid):
+                raise BreakerOpenError(f"expert {uid} breaker is open; skipping")
+            if _CHAOS.enabled:  # injection point: per expert forward/backward RPC
+                await _CHAOS.inject(chaos_point, scope=uid)
+            result = await make_call(self.jobs[uid][0], uid)
+            EXPERT_BREAKERS.register_success(uid)
+            return result
+
         loop_tasks = {
-            asyncio.ensure_future(make_call(self.jobs[uid][0], uid)): uid for uid in job_uids
+            asyncio.ensure_future(_guarded_call(uid)): uid for uid in job_uids
         }
         results: Dict[str, List[np.ndarray]] = {}
         alive_count = [0] * self.batch_size
@@ -116,7 +143,11 @@ class RemoteCallMany:
                         results[uid] = task.result()
                         for sample, _slot in self.jobs[uid][1]:
                             alive_count[sample] += 1
+                    except BreakerOpenError as e:
+                        # not fresh evidence — the breaker already holds the failure
+                        logger.debug(str(e))
                     except Exception as e:
+                        EXPERT_BREAKERS.register_failure(uid)
                         logger.warning(f"expert {uid} failed: {e!r}; masking it out")
                 if (
                     soft_deadline is None
@@ -127,6 +158,11 @@ class RemoteCallMany:
         finally:
             for task in pending:
                 task.cancel()
+                # a deadline-abandoned expert is the breaker's primary target
+                # (the hang that used to cost every batch a full timeout): being
+                # cancelled means it never reached the success/failure paths, so
+                # record the failure here or the breaker can never trip on hangs
+                EXPERT_BREAKERS.register_failure(loop_tasks[task])
         return results
 
     # ------------------------------------------------------------------ forward
@@ -140,7 +176,10 @@ class RemoteCallMany:
             return await expert._call("forward", [x[samples]])
 
         results = get_loop_runner().run_coroutine(
-            self._fan_out(call_forward, self.k_min, self.forward_timeout, list(self.jobs))
+            self._fan_out(
+                call_forward, self.k_min, self.forward_timeout, list(self.jobs),
+                chaos_point="moe.forward",
+            )
         )
 
         outputs = np.zeros((self.batch_size, self.k_max, d_out), np.float32)
@@ -188,7 +227,10 @@ class RemoteCallMany:
             return await expert._call("backward", [x[samples], grads])
 
         results = get_loop_runner().run_coroutine(
-            self._fan_out(call_backward, self.backward_k_min, self.backward_timeout, live_uids)
+            self._fan_out(
+                call_backward, self.backward_k_min, self.backward_timeout, live_uids,
+                chaos_point="moe.backward",
+            )
         )
 
         grad_x = np.zeros_like(x)

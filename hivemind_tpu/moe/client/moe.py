@@ -18,7 +18,7 @@ import numpy as np
 
 from hivemind_tpu.dht import DHT
 from hivemind_tpu.moe.client.beam_search import MoEBeamSearcher
-from hivemind_tpu.moe.client.call_many import RemoteCallMany
+from hivemind_tpu.moe.client.call_many import EXPERT_BREAKERS, RemoteCallMany
 from hivemind_tpu.moe.client.expert import RemoteExpert
 from hivemind_tpu.moe.expert_uid import ExpertInfo
 from hivemind_tpu.p2p import P2P
@@ -101,7 +101,16 @@ class RemoteMixtureOfExperts:
 
     def _mix(self, x: jax.Array, grid_scores: List[jax.Array], chosen: List[List[ExpertInfo]]) -> jax.Array:
         batch_size = x.shape[0]
-        sample_experts = [chosen[sample][: self.k_best] for sample in range(batch_size)]
+        # breaker-aware routing (resilience/breaker.py): experts whose circuit is
+        # hard-open are demoted below every live candidate, so a dead expert does
+        # not burn one of a sample's k_best slots while healthy ones rank lower.
+        # `in EXPERT_BREAKERS` is a pure read; half-open probes happen in _fan_out.
+        sample_experts = []
+        for sample in range(batch_size):
+            candidates = chosen[sample]
+            live = [info for info in candidates if info.uid not in EXPERT_BREAKERS]
+            banned = [info for info in candidates if info.uid in EXPERT_BREAKERS]
+            sample_experts.append((live + banned)[: self.k_best])
         if not any(sample_experts):
             raise RuntimeError("beam search found no experts; is any server declared on this grid?")
         k = max(len(infos) for infos in sample_experts)

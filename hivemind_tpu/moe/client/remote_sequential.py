@@ -24,6 +24,7 @@ from hivemind_tpu.dht import DHT
 from hivemind_tpu.moe.client.expert import RemoteExpert
 from hivemind_tpu.moe.expert_uid import ExpertInfo
 from hivemind_tpu.moe.server.dht_handler import get_experts
+from hivemind_tpu.resilience import RetryPolicy
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.loop import get_loop_runner
 
@@ -41,21 +42,19 @@ class _ResilientBlock(RemoteExpert):
         self._index = index
 
     def _with_retries(self, operation):
-        last_error: Optional[Exception] = None
-        for attempt in range(self._sequential.max_retries + 1):
-            if attempt:
-                fresh = self._sequential._resolve_info(self._index, force=True)
-                self.expert_info = fresh
-                with self._info_lock:
-                    self._info = None  # schema may differ on the new server
-            try:
-                return operation()
-            except Exception as e:
-                last_error = e
-                logger.warning(
-                    f"block {self.uid} via {self.peer_id} failed (attempt {attempt + 1}): {e!r}"
-                )
-        raise RuntimeError(f"block {self.uid} failed after retries") from last_error
+        def on_retry(retry_index: int, error: BaseException) -> None:
+            logger.warning(
+                f"block {self.uid} via {self.peer_id} failed (attempt {retry_index + 1}): {error!r}"
+            )
+            fresh = self._sequential._resolve_info(self._index, force=True)
+            self.expert_info = fresh
+            with self._info_lock:
+                self._info = None  # schema may differ on the new server
+
+        try:
+            return self._sequential.retry_policy.execute_sync(operation, on_retry=on_retry)
+        except Exception as last_error:
+            raise RuntimeError(f"block {self.uid} failed after retries") from last_error
 
     def forward_np(self, *xs):
         return self._with_retries(lambda: RemoteExpert.forward_np(self, *xs))
@@ -106,6 +105,26 @@ class RemoteSequential:
         self._decode_routes: Dict[str, dict] = {}
         self.max_decode_routes = 256  # oldest pinned routes drop beyond this
         self._lock = threading.Lock()
+
+    @property
+    def retry_policy(self) -> RetryPolicy:
+        """Every retry loop in this client shares one declared policy (ISSUE 3):
+        short equal-jittered backoff — a replacement server needs a beat to
+        re-declare the uid, and synchronized clients must not re-dial in
+        lockstep. Derived lazily from ``max_retries`` so changing it (or tests
+        building partial instances) stays honored."""
+        policy = self.__dict__.get("_retry_policy")
+        if policy is None or policy.max_attempts != self.max_retries + 1:
+            policy = RetryPolicy(
+                max_attempts=self.max_retries + 1,
+                base_delay=0.25,
+                backoff=2.0,
+                max_delay=2.0,
+                jitter="equal",
+                name="remote_sequential",
+            )
+            self.__dict__["_retry_policy"] = policy
+        return policy
 
     def __len__(self) -> int:
         return self.num_blocks
@@ -185,17 +204,23 @@ class RemoteSequential:
         otherwise retry the whole range on a partially-advanced activation, silently
         double-applying the blocks that already ran (corrupting the custom_vjp
         primal on exactly the failover path the retry exists for)."""
-        last_error: Optional[Exception] = None
-        for attempt in range(self.max_retries + 1):
-            try:
-                current = x
-                for head, _uids in self._grouped_range(start, stop, force=attempt > 0):
-                    current = head.forward_np(current)[0]
-                return current
-            except Exception as e:
-                last_error = e
-                logger.warning(f"span forward [{start}, {stop}) failed (attempt {attempt + 1}): {e!r}")
-        raise RuntimeError(f"span forward [{start}, {stop}) failed after retries") from last_error
+        attempt_counter = [0]
+
+        def one_attempt():
+            force = attempt_counter[0] > 0
+            attempt_counter[0] += 1
+            current = x
+            for head, _uids in self._grouped_range(start, stop, force=force):
+                current = head.forward_np(current)[0]
+            return current
+
+        def on_retry(retry_index: int, error: BaseException) -> None:
+            logger.warning(f"span forward [{start}, {stop}) failed (attempt {retry_index + 1}): {error!r}")
+
+        try:
+            return self.retry_policy.execute_sync(one_attempt, on_retry=on_retry)
+        except Exception as last_error:
+            raise RuntimeError(f"span forward [{start}, {stop}) failed after retries") from last_error
 
     def _span_backward(self, start: int, stop: int, x, grad):
         """Chained backward over the range. With one co-located span the server does
@@ -206,28 +231,33 @@ class RemoteSequential:
         NEVER replay a group whose backward already succeeded — progress is tracked
         as a shrinking [start, remaining) range and only the remainder is retried
         (forward sweeps are side-effect-free and safe to re-run)."""
-        last_error: Optional[Exception] = None
-        remaining = stop
-        for attempt in range(self.max_retries + 1):
-            if remaining <= start:
-                return grad
-            try:
-                groups = self._grouped_range(start, remaining, force=attempt > 0)
-                boundary_inputs, current = [], x
-                for head, _uids in groups:
-                    boundary_inputs.append(current)
-                    if head is not groups[-1][0]:
-                        current = head.forward_np(current)[0]
-                for (head, uids), block_input in zip(reversed(groups), reversed(boundary_inputs)):
-                    grad = head.backward_np(block_input, grad)[0]
-                    remaining -= len(uids)  # this group's optimizers have stepped
-                return grad
-            except Exception as e:
-                last_error = e
-                logger.warning(
-                    f"span backward [{start}, {remaining}) failed (attempt {attempt + 1}): {e!r}"
-                )
-        raise RuntimeError(f"span backward [{start}, {stop}) failed after retries") from last_error
+        state = {"remaining": stop, "grad": grad, "attempt": 0}
+
+        def one_attempt():
+            force = state["attempt"] > 0
+            state["attempt"] += 1
+            if state["remaining"] <= start:
+                return state["grad"]
+            groups = self._grouped_range(start, state["remaining"], force=force)
+            boundary_inputs, current = [], x
+            for head, _uids in groups:
+                boundary_inputs.append(current)
+                if head is not groups[-1][0]:
+                    current = head.forward_np(current)[0]
+            for (head, uids), block_input in zip(reversed(groups), reversed(boundary_inputs)):
+                state["grad"] = head.backward_np(block_input, state["grad"])[0]
+                state["remaining"] -= len(uids)  # this group's optimizers have stepped
+            return state["grad"]
+
+        def on_retry(retry_index: int, error: BaseException) -> None:
+            logger.warning(
+                f"span backward [{start}, {state['remaining']}) failed (attempt {retry_index + 1}): {error!r}"
+            )
+
+        try:
+            return self.retry_policy.execute_sync(one_attempt, on_retry=on_retry)
+        except Exception as last_error:
+            raise RuntimeError(f"span backward [{start}, {stop}) failed after retries") from last_error
 
     def __call__(self, x: jax.Array, start: int = 0, stop: Optional[int] = None) -> jax.Array:
         """Run blocks [start, stop) in order; differentiable end to end. Co-located
@@ -381,24 +411,25 @@ class RemoteSequential:
         (a replacement server may take a moment to re-declare the uid)."""
         import numpy as np
 
-        last_error: Optional[Exception] = None
-        for attempt in range(self.max_retries + 1):
-            try:
-                route = self._grouped_range(0, self.num_blocks, force=True)
-                out = history
-                for block, span in route:
-                    out = block.decode_np(out, session_id, reset=True, span=span)
-                state["route"] = route
-                return np.asarray(out, np.float32)
-            except Exception as e:
-                last_error = e
-                logger.warning(
-                    f"decode failover for {session_id!r} failed (attempt {attempt + 1}): {e!r}"
-                )
-                time.sleep(min(0.5 * (attempt + 1), 2.0))
-        raise RuntimeError(
-            f"decode session {session_id!r} could not fail over after retries"
-        ) from last_error
+        def one_attempt():
+            route = self._grouped_range(0, self.num_blocks, force=True)
+            out = history
+            for block, span in route:
+                out = block.decode_np(out, session_id, reset=True, span=span)
+            state["route"] = route
+            return np.asarray(out, np.float32)
+
+        def on_retry(retry_index: int, error: BaseException) -> None:
+            logger.warning(
+                f"decode failover for {session_id!r} failed (attempt {retry_index + 1}): {error!r}"
+            )
+
+        try:
+            return self.retry_policy.execute_sync(one_attempt, on_retry=on_retry)
+        except Exception as last_error:
+            raise RuntimeError(
+                f"decode session {session_id!r} could not fail over after retries"
+            ) from last_error
 
     def close_decode_session(self, session_id: str) -> None:
         """Forget a pinned decode route and its retained history (the server side

@@ -41,6 +41,8 @@ from hivemind_tpu.p2p.mux import (
     StreamClosedError,
 )
 from hivemind_tpu.p2p.peer_id import Multiaddr, PeerID
+from hivemind_tpu.resilience import CHAOS as _CHAOS
+from hivemind_tpu.resilience import Deadline
 from hivemind_tpu.utils.crypto import Ed25519PrivateKey
 from hivemind_tpu.utils.logging import get_logger
 
@@ -407,14 +409,18 @@ class P2P:
 
         writer = None
         registered = failed = False
+        # ONE dial_timeout budget for the whole registration handshake instead of
+        # three stacked hard-coded 5 s waits: a slow host gets the full configured
+        # budget, and the worst case can no longer add up to 3x the intended wait
+        budget = Deadline(self._dial_timeout)
         try:
-            reader, writer = await asyncio.wait_for(self._open_daemon_connection(), timeout=5.0)
+            reader, writer = await budget.wait_for(self._open_daemon_connection())
             request = b"Y" + struct.pack(">HH", public_port, local_port)
             writer.write(struct.pack(">I", len(request)) + request)
             await writer.drain()
-            header = await asyncio.wait_for(reader.readexactly(4), timeout=5.0)
+            header = await budget.wait_for(reader.readexactly(4))
             (length,) = struct.unpack(">I", header)
-            response = await asyncio.wait_for(reader.readexactly(length), timeout=5.0)
+            response = await budget.wait_for(reader.readexactly(length))
             if len(response) == 3 and response[0:1] == b"O":
                 self._inbound_proxy_writer = writer
                 registered = True
@@ -823,6 +829,8 @@ class P2P:
         payload = _serialize(request)
         started = time.perf_counter()
         try:
+            if _CHAOS.enabled:  # injection point: drop/delay/corrupt the outbound request
+                payload = await _CHAOS.inject("p2p.unary.send", payload=payload, scope=str(self.peer_id))
             for attempt in range(2):
                 stream = await self._open_stream_with_redial(peer_id, name)
                 try:
@@ -849,6 +857,10 @@ class P2P:
                             f"{name}: stream closed before response"
                             + ("" if idempotent else " (not retried: RPC not marked idempotent)")
                         ) from None
+                    if _CHAOS.enabled:  # injection point: lose/corrupt the response
+                        response = await _CHAOS.inject(
+                            "p2p.unary.recv", payload=response, scope=str(self.peer_id)
+                        )
                     _RPC_BYTES.inc(len(payload), handler=name, direction="out")
                     _RPC_BYTES.inc(len(response), handler=name, direction="in")
                     return _parse(response, response_type)
@@ -879,10 +891,18 @@ class P2P:
                 if hasattr(requests, "__aiter__"):
                     async for request in requests:
                         payload = _serialize(request)
+                        if _CHAOS.enabled:  # injection point: per streamed request message
+                            payload = await _CHAOS.inject(
+                                "p2p.stream.send", payload=payload, scope=str(self.peer_id)
+                            )
                         bytes_out += len(payload)
                         await stream.send(payload)
                 else:
                     payload = _serialize(requests)
+                    if _CHAOS.enabled:
+                        payload = await _CHAOS.inject(
+                            "p2p.stream.send", payload=payload, scope=str(self.peer_id)
+                        )
                     bytes_out += len(payload)
                     await stream.send(payload)
                 await stream.close_send()
@@ -909,6 +929,10 @@ class P2P:
                 except RemoteError as e:
                     _RPC_ERRORS.inc(handler=name, side="client")
                     raise P2PHandlerError(str(e)) from e
+                if _CHAOS.enabled:  # injection point: per streamed response message
+                    message = await _CHAOS.inject(
+                        "p2p.stream.recv", payload=message, scope=str(self.peer_id)
+                    )
                 bytes_in += len(message)
                 yield _parse(message, response_type)
         finally:

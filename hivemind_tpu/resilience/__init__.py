@@ -1,0 +1,39 @@
+"""Swarm resilience layer (ISSUE 3): unified retry/backoff/deadline policies,
+cross-layer circuit breakers, and a deterministic chaos/fault-injection engine.
+See docs/resilience.md for the catalog and per-layer failure-propagation table."""
+
+from hivemind_tpu.resilience.breaker import (
+    BreakerBoard,
+    BreakerOpenError,
+    BreakerState,
+    CircuitBreaker,
+    reset_all_boards,
+)
+from hivemind_tpu.resilience.chaos import (
+    ACTIONS,
+    CHAOS,
+    ChaosAbort,
+    ChaosDrop,
+    ChaosEngine,
+    ChaosError,
+    INJECTION_POINTS,
+)
+from hivemind_tpu.resilience.policy import Deadline, DeadlineExceeded, RetryPolicy
+
+__all__ = [
+    "ACTIONS",
+    "BreakerBoard",
+    "BreakerOpenError",
+    "BreakerState",
+    "CHAOS",
+    "ChaosAbort",
+    "ChaosDrop",
+    "ChaosEngine",
+    "ChaosError",
+    "CircuitBreaker",
+    "Deadline",
+    "DeadlineExceeded",
+    "INJECTION_POINTS",
+    "RetryPolicy",
+    "reset_all_boards",
+]

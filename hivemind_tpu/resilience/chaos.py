@@ -1,0 +1,256 @@
+"""First-class deterministic fault injection (ISSUE 3 tentpole).
+
+Fault injection used to exist only as test-local subclasses
+(``FaultyAllReduceRunner`` / ``FaultyAverager``); this module makes it a
+subsystem: production code carries **named injection points** that are free
+when disabled (one attribute check) and, when armed, consult a seeded schedule
+— no wall-clock randomness, so a failing chaos run replays exactly.
+
+Named injection points (the call sites pass ``scope=<local peer id or expert
+uid>`` so multi-peer-in-one-process tests can fault exactly one peer):
+
+==========================  ====================================================
+point                       where it fires
+==========================  ====================================================
+``p2p.unary.send``          client side, before a unary request leaves
+``p2p.unary.recv``          client side, after a unary response arrives
+``p2p.stream.send``         client side, before each streamed request message
+``p2p.stream.recv``         client side, after each streamed response message
+``dht.rpc_ping``            before an outbound DHT ping
+``dht.rpc_store``           before an outbound DHT store
+``dht.rpc_find``            before an outbound DHT find
+``allreduce.setup``         before constructing a round's AllReduceRunner
+``allreduce.load``          sender side, per tensor part streamed to a reducer
+``allreduce.reduce``        reducer side, per delta returned to a sender
+``moe.forward``             per expert forward RPC (scope = expert uid)
+``moe.backward``            per expert backward RPC (scope = expert uid)
+==========================  ====================================================
+
+Actions: ``drop`` (raises :class:`ChaosDrop`, a ``ConnectionError`` — looks
+like the network ate it), ``delay`` (sleeps ``delay`` seconds), ``abort``
+(raises :class:`ChaosAbort`, a ``RuntimeError`` — looks like a peer crash or
+software fault), ``corrupt_payload`` (deterministically flips bytes in the
+payload when the point carries one).
+
+Activation: programmatically (``CHAOS.add_rule(...)`` / ``CHAOS.configure``)
+or via ``HIVEMIND_CHAOS`` at import, e.g.::
+
+    HIVEMIND_CHAOS="seed=7;dht.rpc_find:drop:prob=0.2;allreduce.load:delay:delay=0.5:prob=0.1"
+
+Grammar: ``spec = segment (";" segment)*``; a segment is either ``seed=<int>``
+or ``<point>:<action>[:key=value]...`` with keys ``prob`` (default 1.0),
+``delay`` (seconds, default 0.1), ``after`` (skip the first N matching calls),
+``times`` (max injections), ``scope`` (substring matched against the call
+site's scope). A point may end in ``*`` for prefix matching (``p2p.*``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
+from hivemind_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_CHAOS_INJECTIONS = _TELEMETRY.counter(
+    "hivemind_chaos_injections_total", "faults injected by the chaos engine", ("point", "action")
+)
+
+INJECTION_POINTS = (
+    "p2p.unary.send", "p2p.unary.recv", "p2p.stream.send", "p2p.stream.recv",
+    "dht.rpc_ping", "dht.rpc_store", "dht.rpc_find",
+    "allreduce.setup", "allreduce.load", "allreduce.reduce",
+    "moe.forward", "moe.backward",
+)
+
+ACTIONS = ("drop", "delay", "abort", "corrupt_payload")
+
+
+class ChaosError(Exception):
+    """Base for engine-raised faults (never raised unless chaos is armed)."""
+
+
+class ChaosDrop(ChaosError, ConnectionError):
+    """Injected message loss: call sites see an ordinary ConnectionError."""
+
+
+class ChaosAbort(ChaosError, RuntimeError):
+    """Injected crash/software fault: an unexpected RuntimeError."""
+
+
+@dataclass
+class ChaosRule:
+    point: str
+    action: str
+    prob: float = 1.0
+    delay: float = 0.1
+    after: int = 0
+    times: Optional[int] = None
+    scope: Optional[str] = None
+    rng: random.Random = field(default_factory=random.Random, repr=False)
+    calls: int = 0
+    hits: int = 0
+
+    def matches(self, point: str, scope: Optional[str]) -> bool:
+        if self.point.endswith("*"):
+            if not point.startswith(self.point[:-1]):
+                return False
+        elif point != self.point:
+            return False
+        if self.scope is not None:
+            if scope is None or self.scope not in scope:
+                return False
+        return True
+
+    def decide(self) -> bool:
+        """One deterministic injection decision. Counters make ``after``/``times``
+        schedules reproducible; the rule-local rng makes ``prob`` reproducible."""
+        index = self.calls
+        self.calls += 1
+        if index < self.after:
+            return False
+        if self.times is not None and self.hits >= self.times:
+            return False
+        if self.prob < 1.0 and self.rng.random() >= self.prob:
+            return False
+        self.hits += 1
+        return True
+
+
+def _rule_seed(seed: int, index: int, point: str, action: str) -> int:
+    return zlib.crc32(f"{seed}|{index}|{point}|{action}".encode())
+
+
+class ChaosEngine:
+    """The process-wide fault injector. ``enabled`` is False with no rules, so
+    instrumented call sites cost one attribute read in production."""
+
+    def __init__(self, seed: int = 0):
+        self._rules: List[ChaosRule] = []
+        self._seed = seed
+        self.enabled = False
+
+    # ------------------------------------------------------------------ config
+
+    def add_rule(
+        self,
+        point: str,
+        action: str,
+        *,
+        prob: float = 1.0,
+        delay: float = 0.1,
+        after: int = 0,
+        times: Optional[int] = None,
+        scope: Optional[str] = None,
+    ) -> ChaosRule:
+        assert action in ACTIONS, f"unknown chaos action {action!r} (choose from {ACTIONS})"
+        if not point.endswith("*") and point not in INJECTION_POINTS:
+            logger.warning(f"chaos rule targets unknown injection point {point!r}")
+        rule = ChaosRule(
+            point=point, action=action, prob=prob, delay=delay, after=after,
+            times=times, scope=scope,
+            rng=random.Random(_rule_seed(self._seed, len(self._rules), point, action)),
+        )
+        self._rules.append(rule)
+        self.enabled = True
+        return rule
+
+    def configure(self, spec: str, seed: Optional[int] = None) -> None:
+        """Parse the ``HIVEMIND_CHAOS`` grammar (see module docstring) into rules.
+        Clears existing rules first."""
+        self.clear()
+        segments = [segment.strip() for segment in spec.split(";") if segment.strip()]
+        # the seed segment applies to every rule regardless of position
+        for segment in segments:
+            if segment.startswith("seed="):
+                seed = int(segment[len("seed="):])
+        if seed is not None:
+            self._seed = seed
+        for segment in segments:
+            if segment.startswith("seed="):
+                continue
+            fields = segment.split(":")
+            if len(fields) < 2:
+                raise ValueError(f"bad chaos segment {segment!r}: need <point>:<action>")
+            point, action = fields[0], fields[1]
+            kwargs: Dict[str, object] = {}
+            for kv in fields[2:]:
+                key, _, value = kv.partition("=")
+                if key in ("prob", "delay"):
+                    kwargs[key] = float(value)
+                elif key in ("after", "times"):
+                    kwargs[key] = int(value)
+                elif key == "scope":
+                    kwargs[key] = value
+                else:
+                    raise ValueError(f"unknown chaos rule key {key!r} in {segment!r}")
+            self.add_rule(point, action, **kwargs)
+
+    def configure_from_env(self, environ=os.environ) -> None:
+        spec = environ.get("HIVEMIND_CHAOS")
+        if spec:
+            self.configure(spec)
+            logger.warning(f"HIVEMIND_CHAOS armed: {len(self._rules)} fault rule(s) active")
+
+    def clear(self) -> None:
+        self._rules = []
+        self.enabled = False
+
+    def reseed(self, seed: int) -> None:
+        """Set the seed for ALL rules — existing ones get fresh rngs and reset
+        counters, so reseed-then-replay is deterministic regardless of whether
+        rules were added before or after the call."""
+        self._seed = seed
+        for index, rule in enumerate(self._rules):
+            rule.rng = random.Random(_rule_seed(seed, index, rule.point, rule.action))
+            rule.calls = rule.hits = 0
+
+    @property
+    def rules(self) -> Tuple[ChaosRule, ...]:
+        return tuple(self._rules)
+
+    def stats(self) -> Dict[str, int]:
+        """Injections performed so far, keyed ``point:action``."""
+        out: Dict[str, int] = {}
+        for rule in self._rules:
+            key = f"{rule.point}:{rule.action}"
+            out[key] = out.get(key, 0) + rule.hits
+        return out
+
+    # ------------------------------------------------------------------ injection
+
+    async def inject(self, point: str, payload=None, scope: Optional[str] = None):
+        """Consult the schedule at one injection point. Returns the (possibly
+        corrupted) payload; may sleep; may raise ChaosDrop / ChaosAbort."""
+        for rule in self._rules:
+            if not rule.matches(point, scope) or not rule.decide():
+                continue
+            _CHAOS_INJECTIONS.inc(point=point, action=rule.action)
+            if rule.action == "drop":
+                raise ChaosDrop(f"chaos: dropped at {point}")
+            if rule.action == "abort":
+                raise ChaosAbort(f"chaos: aborted at {point}")
+            if rule.action == "delay":
+                await asyncio.sleep(rule.delay)
+            elif rule.action == "corrupt_payload":
+                payload = self._corrupt(payload, rule.rng)
+        return payload
+
+    @staticmethod
+    def _corrupt(payload, rng: random.Random):
+        if isinstance(payload, (bytes, bytearray)) and len(payload):
+            corrupted = bytearray(payload)
+            for _ in range(max(1, len(corrupted) // 256)):
+                corrupted[rng.randrange(len(corrupted))] ^= 0xFF
+            return bytes(corrupted)
+        return payload  # point carries no byte payload: corruption is a no-op
+
+
+CHAOS = ChaosEngine()
+CHAOS.configure_from_env()

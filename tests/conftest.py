@@ -43,8 +43,11 @@ def pytest_pyfunc_call(pyfuncitem):
 def cleanup_children():
     """Reset process-wide singletons between tests (reference tests/conftest.py:14-33)."""
     yield
+    from hivemind_tpu.resilience import CHAOS, reset_all_boards
     from hivemind_tpu.utils.crypto import Ed25519PrivateKey
 
+    CHAOS.clear()  # a test's armed fault rules must never leak into the next test
+    reset_all_boards()  # module-level breaker boards (e.g. moe EXPERT_BREAKERS) too
     Ed25519PrivateKey.reset_process_wide()
     gc.collect()
 

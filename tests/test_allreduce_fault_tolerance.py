@@ -1,17 +1,20 @@
 """Fault-injection matrix: a faulty peer dies/stalls at each stage of the all-reduce;
 surviving peers must still complete with consistent averages
-(scope: reference tests/test_allreduce_fault_tolerance.py:22-120)."""
+(scope: reference tests/test_allreduce_fault_tolerance.py:22-120).
 
-import asyncio
+ISSUE 3: the fault matrix now runs on the first-class chaos engine
+(hivemind_tpu/resilience/chaos.py) — seeded rules scoped to the faulty peer's id
+at the named ``allreduce.setup`` / ``allreduce.load`` / ``allreduce.reduce``
+injection points replace the old ``FaultyAllReduceRunner`` / ``FaultyAverager``
+test-local subclasses, so the code under test is EXACTLY the production code."""
+
 from enum import Enum, auto
 
 import numpy as np
 import pytest
 
-from hivemind_tpu.averaging import AllReduceRunner, DecentralizedAverager
-from hivemind_tpu.averaging.allreduce import AveragingMode
-from hivemind_tpu.dht import DHT
-from hivemind_tpu.proto import averaging_pb2
+from hivemind_tpu.averaging import DecentralizedAverager
+from hivemind_tpu.resilience import CHAOS
 
 from swarm_utils import launch_dht_swarm
 
@@ -19,108 +22,51 @@ from swarm_utils import launch_dht_swarm
 class Fault(Enum):
     NONE = auto()
     FAIL_BEFORE = auto()  # dies after matchmaking, before sending anything
-    FAIL_SENDING = auto()  # sends the first part, then closes its streams
+    FAIL_SENDING = auto()  # sends the first part, then its sends abort
     SLOW_SENDING = auto()  # stalls while sending
-    FAIL_REDUCING = auto()  # returns one delta, then stops reducing
+    FAIL_REDUCING = auto()  # returns one delta, then its reduces abort
     SLOW_REDUCING = auto()  # stalls while reducing
     CANCEL = auto()  # cancels its own step right after scheduling it
 
 
-class FaultyAllReduceRunner(AllReduceRunner):
-    def __init__(self, *args, fault: Fault, **kwargs):
-        self.fault = fault
-        super().__init__(*args, **kwargs)
-
-    async def _communicate_with_peer(self, peer_index):
-        if self.fault in (Fault.FAIL_SENDING, Fault.SLOW_SENDING):
-            peer_id = self.ordered_peer_ids[peer_index]
-            stub = self.get_stub(peer_id)
-
-            async def _requests():
-                first = True
-                async for serialized in self.container.iterate_input_parts_for(peer_index):
-                    if not first:
-                        if self.fault == Fault.SLOW_SENDING:
-                            await asyncio.sleep(30)
-                        return  # FAIL_SENDING: close stream after one part
-                    yield averaging_pb2.AveragingData(
-                        code=averaging_pb2.PART_DATA,
-                        group_id=self.group_id,
-                        tensor_part=serialized,
-                        weight=self.weight,
-                    )
-                    first = False
-
-            try:
-                async for _response in stub.rpc_aggregate_part(_requests()):
-                    pass
-            except Exception:
-                pass
-            self.container.register_failed_reducer(peer_index)
-            return
-        await super()._communicate_with_peer(peer_index)
-
-    async def handle_aggregate_stream(self, first_message, requests, context):
-        if self.fault in (Fault.FAIL_REDUCING, Fault.SLOW_REDUCING):
-            count = 0
-            async for message in super().handle_aggregate_stream(first_message, requests, context):
-                yield message
-                count += 1
-                if count >= 1:
-                    if self.fault == Fault.SLOW_REDUCING:
-                        await asyncio.sleep(30)
-                    return  # close the response stream early
-            return
-        async for message in super().handle_aggregate_stream(first_message, requests, context):
-            yield message
+def arm_fault(fault: Fault, faulty_scope: str) -> None:
+    """Translate one matrix entry into seeded chaos rules scoped to the faulty
+    peer (every peer shares the process-wide engine; scope isolates the victim)."""
+    CHAOS.clear()
+    CHAOS.reseed(1234)
+    if fault == Fault.FAIL_BEFORE:
+        CHAOS.add_rule("allreduce.setup", "abort", scope=faulty_scope)
+    elif fault == Fault.FAIL_SENDING:
+        CHAOS.add_rule("allreduce.load", "abort", after=1, scope=faulty_scope)
+    elif fault == Fault.SLOW_SENDING:
+        # delay >> sender_timeout: indistinguishable from a stall to the group
+        CHAOS.add_rule("allreduce.load", "delay", delay=8.0, after=1, scope=faulty_scope)
+    elif fault == Fault.FAIL_REDUCING:
+        CHAOS.add_rule("allreduce.reduce", "abort", after=1, scope=faulty_scope)
+    elif fault == Fault.SLOW_REDUCING:
+        CHAOS.add_rule("allreduce.reduce", "delay", delay=8.0, after=1, scope=faulty_scope)
+    # NONE / CANCEL need no injected faults
 
 
-class FaultyAverager(DecentralizedAverager):
-    def __init__(self, *args, fault: Fault = Fault.NONE, **kwargs):
-        self.fault = fault
-        super().__init__(*args, **kwargs)
-
-    def _make_allreduce_runner(self, group_info, peer_element_counts, modes, weight):
-        if self.fault == Fault.FAIL_BEFORE:
-            raise RuntimeError("injected failure before allreduce")
-        if self.fault == Fault.NONE:
-            return super()._make_allreduce_runner(group_info, peer_element_counts, modes, weight)
-        return FaultyAllReduceRunner(
-            fault=self.fault,
-            p2p=self.p2p,
-            group_id=group_info.group_id,
-            tensors=self._snapshot_tensors(),
-            ordered_peer_ids=group_info.peer_ids,
-            peer_element_counts=peer_element_counts,
-            modes=modes,
-            get_stub=self._get_peer_stub,
-            weight=weight,
-            compression=self.compression,
-            part_size_bytes=self.part_size_bytes,
-            sender_timeout=self.sender_timeout,
-            reducer_timeout=self.reducer_timeout,
-        )
-
-
-def launch_faulty_swarm(n_peers: int, fault_index: int, fault: Fault, part_size_bytes=64):
+def launch_swarm_of_averagers(n_peers: int, part_size_bytes=64):
     dhts = launch_dht_swarm(n_peers)
     averagers = []
     for i, dht in enumerate(dhts):
         rng = np.random.RandomState(100 + i)
         tensors = [rng.randn(256).astype(np.float32)]
         averagers.append(
-            FaultyAverager(
+            DecentralizedAverager(
                 tensors, dht, prefix="faulttest", start=True,
                 target_group_size=n_peers,
                 min_matchmaking_time=1.0, request_timeout=1.0,
-                sender_timeout=2.0, reducer_timeout=4.0,
+                sender_timeout=1.5, reducer_timeout=2.0,
                 part_size_bytes=part_size_bytes,  # small parts: faults hit mid-stream
-                fault=fault if i == fault_index else Fault.NONE,
             )
         )
     return dhts, averagers
 
 
+@pytest.mark.chaos
 @pytest.mark.parametrize(
     "fault",
     [Fault.NONE, Fault.FAIL_BEFORE, Fault.FAIL_SENDING, Fault.SLOW_SENDING, Fault.FAIL_REDUCING, Fault.SLOW_REDUCING, Fault.CANCEL],
@@ -128,8 +74,9 @@ def launch_faulty_swarm(n_peers: int, fault_index: int, fault: Fault, part_size_
 )
 def test_allreduce_fault_tolerance(fault):
     n_peers, fault_index = 4, 1
-    dhts, averagers = launch_faulty_swarm(n_peers, fault_index, fault)
+    dhts, averagers = launch_swarm_of_averagers(n_peers)
     try:
+        arm_fault(fault, faulty_scope=str(averagers[fault_index].peer_id))
         controls = [a.step(wait=False, timeout=25, allow_retries=False) for a in averagers]
         if fault == Fault.CANCEL:
             # reference test_allreduce_fault_tolerance.py:22-120 CANCEL case: the
@@ -167,7 +114,11 @@ def test_allreduce_fault_tolerance(fault):
                 axis=0,
             )
             assert agreement.mean() >= 0.5, f"{fault.name}: survivors agree on only {agreement.mean():.0%}"
+        if fault not in (Fault.NONE, Fault.CANCEL):
+            injected = sum(CHAOS.stats().values())
+            assert injected >= 1, f"{fault.name}: chaos rules armed but nothing injected"
     finally:
+        CHAOS.clear()
         for averager in averagers:
             averager.shutdown()
         for dht in dhts:

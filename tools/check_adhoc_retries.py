@@ -1,0 +1,173 @@
+#!/usr/bin/env python3
+"""Lint: keep failure handling in the resilience layer (ISSUE 3 satellite).
+
+Flags, everywhere under ``hivemind_tpu/`` EXCEPT ``resilience/``:
+
+1. ``swallow`` — a bare ``except:`` / ``except Exception:`` / ``except
+   BaseException:`` whose body is exactly ``pass``: silent failure handling.
+   Use a logged warning + telemetry counter, or a narrower exception type.
+2. ``retry-loop`` — a ``while``/``for`` loop that both sleeps via
+   ``asyncio.sleep``/``time.sleep`` AND swallows broad exceptions to keep
+   looping: a hand-rolled retry loop. Use
+   :class:`hivemind_tpu.resilience.RetryPolicy` instead.
+
+Findings are keyed ``(relative path, enclosing def, kind)`` — stable across
+line-number churn. Pre-existing occurrences reviewed at introduction time are
+grandfathered in ``ALLOWLIST``; the wired-in test fails on anything NEW, and
+warns on stale allowlist entries so the list shrinks over time.
+
+Run directly (``python tools/check_adhoc_retries.py``) or via
+``tests/test_resilience.py::test_no_new_adhoc_failure_handling``.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List, Set, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PACKAGE_ROOT = REPO_ROOT / "hivemind_tpu"
+
+Finding = Tuple[str, str, str]  # (relpath, enclosing function, kind)
+
+# Grandfathered occurrences, reviewed when this lint was introduced. Do not add
+# to this list — route new failure handling through hivemind_tpu/resilience/.
+ALLOWLIST: Set[Finding] = {
+    # best-effort teardown during create/shutdown/del: failures here must never
+    # mask the original exception, and there is nothing useful to log mid-unwind
+    ("p2p/p2p.py", "P2P.create", "swallow"),
+    ("p2p/p2p.py", "P2P.shutdown", "swallow"),
+    ("p2p/mux.py", "MuxConnection.close", "swallow"),
+    ("p2p/crypto_channel.py", "SecureChannel.close", "swallow"),
+    ("p2p/crypto_channel.py", "SecureChannel.wait_closed", "swallow"),
+    ("dht/dht.py", "DHT.__del__", "swallow"),
+    # prctl/platform probes where absence IS the answer
+    ("p2p/native_transport.py", "_die_with_parent", "swallow"),
+    ("moe/server/llama_loader.py", "device_hbm_bytes", "swallow"),
+    # parser fallback chain (tries multiaddr forms in order)
+    ("p2p/peer_id.py", "Multiaddr.parse", "swallow"),
+    # periodic stats publishing: failure is cosmetic by design
+    ("moe/server/runtime.py", "Runtime._maybe_report_stats", "swallow"),
+}
+
+
+def _broad_handler(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    if isinstance(handler.type, ast.Name) and handler.type.id in ("Exception", "BaseException"):
+        return True
+    if isinstance(handler.type, ast.Tuple):
+        return any(
+            isinstance(element, ast.Name) and element.id in ("Exception", "BaseException")
+            for element in handler.type.elts
+        )
+    return False
+
+
+def _is_sleep_call(node: ast.AST) -> bool:
+    call = node
+    if isinstance(call, ast.Await):
+        call = call.value
+    if not isinstance(call, ast.Call):
+        return False
+    fn = call.func
+    return (
+        isinstance(fn, ast.Attribute)
+        and fn.attr == "sleep"
+        and isinstance(fn.value, ast.Name)
+        and fn.value.id in ("asyncio", "time")
+    )
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, relpath: str):
+        self.relpath = relpath
+        self.findings: List[Tuple[Finding, int]] = []
+        self._scope: List[str] = []
+
+    # --- scope tracking -------------------------------------------------
+    def _visit_scoped(self, node):
+        self._scope.append(node.name)
+        self.generic_visit(node)
+        self._scope.pop()
+
+    visit_FunctionDef = visit_AsyncFunctionDef = visit_ClassDef = _visit_scoped
+
+    def _qualname(self) -> str:
+        return ".".join(self._scope) if self._scope else "<module>"
+
+    def _record(self, kind: str, lineno: int) -> None:
+        self.findings.append(((self.relpath, self._qualname(), kind), lineno))
+
+    # --- rules ----------------------------------------------------------
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        if _broad_handler(node) and len(node.body) == 1 and isinstance(node.body[0], ast.Pass):
+            self._record("swallow", node.lineno)
+        self.generic_visit(node)
+
+    def _visit_loop(self, node):
+        sleeps = any(_is_sleep_call(child) for child in ast.walk(node))
+        swallows_to_loop = False
+        for child in ast.walk(node):
+            if not isinstance(child, ast.Try):
+                continue
+            for handler in child.handlers:
+                if not _broad_handler(handler):
+                    continue
+                # "keep looping silently" shapes: pass / continue only — a handler
+                # that logs and counts before continuing is the approved pattern
+                if all(isinstance(stmt, (ast.Pass, ast.Continue)) for stmt in handler.body):
+                    swallows_to_loop = True
+        if sleeps and swallows_to_loop:
+            self._record("retry-loop", node.lineno)
+        self.generic_visit(node)
+
+    visit_While = visit_For = visit_AsyncFor = _visit_loop
+
+
+def collect_findings(package_root: Path = PACKAGE_ROOT) -> List[Tuple[Finding, int]]:
+    findings: List[Tuple[Finding, int]] = []
+    for path in sorted(package_root.rglob("*.py")):
+        parts = path.relative_to(package_root).parts
+        if "resilience" in parts or "__pycache__" in parts:
+            continue
+        relpath = "/".join(parts)
+        tree = ast.parse(path.read_text(), filename=str(path))
+        visitor = _Visitor(relpath)
+        visitor.visit(tree)
+        findings.extend(visitor.findings)
+    return findings
+
+
+def check(package_root: Path = PACKAGE_ROOT) -> Tuple[List[str], List[str]]:
+    """Returns (new_violations, stale_allowlist_entries) as printable strings."""
+    found = collect_findings(package_root)
+    found_keys = {key for key, _lineno in found}
+    new = [
+        f"{key[0]}:{lineno} [{key[2]}] in {key[1]} — "
+        + ("use RetryPolicy from hivemind_tpu.resilience" if key[2] == "retry-loop"
+           else "log + count instead of silently passing")
+        for key, lineno in sorted(found)
+        if key not in ALLOWLIST
+    ]
+    stale = [f"{entry[0]} [{entry[2]}] in {entry[1]}" for entry in sorted(ALLOWLIST - found_keys)]
+    return new, stale
+
+
+def main() -> int:
+    new, stale = check()
+    for entry in stale:
+        print(f"note: stale allowlist entry (cleaned up — remove it): {entry}")
+    if new:
+        print(f"{len(new)} new ad-hoc failure-handling site(s) outside hivemind_tpu/resilience/:")
+        for violation in new:
+            print(f"  {violation}")
+        return 1
+    print("ok: no new ad-hoc retry loops or silent except blocks")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
