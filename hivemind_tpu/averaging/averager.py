@@ -52,6 +52,7 @@ GatheredData = Dict[PeerID, Any]
 # layer-3 telemetry (docs/observability.md + ISSUE 3 satellite): internal errors
 # this module used to swallow silently, now logged AND counted by site
 from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
+from hivemind_tpu.telemetry.tracing import trace as _tracing_span
 
 _AVERAGER_INTERNAL_ERRORS = _TELEMETRY.counter(
     "hivemind_averaging_internal_errors_total",
@@ -378,6 +379,14 @@ class DecentralizedAverager(ServicerBase):
     async def _aggregate_with_group(self, group_info: GroupInfo, weight: float) -> GatheredData:
         """Decode gathered metadata, balance load, run the all-reduce, apply deltas
         (reference averager.py:514-562)."""
+        with _tracing_span(
+            "averaging.aggregate",
+            peer=str(self.peer_id),
+            group_size=len(group_info.peer_ids),
+        ):
+            return await self._aggregate_with_group_traced(group_info, weight)
+
+    async def _aggregate_with_group_traced(self, group_info: GroupInfo, weight: float) -> GatheredData:
         bandwidths, modes, user_gathered = self._decode_gathered(group_info)
         await self._pre_allreduce()
 

@@ -33,6 +33,7 @@ logger = get_logger(__name__)
 # layer-3 telemetry (docs/observability.md): how long group formation takes and
 # how often it fails — the first place to look when a training round stalls
 from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
+from hivemind_tpu.telemetry.tracing import trace as _tracing_span
 
 _MATCHMAKING_WAIT = _TELEMETRY.histogram(
     "hivemind_averaging_matchmaking_seconds",
@@ -187,32 +188,40 @@ class Matchmaking:
             wait_started = time.perf_counter()  # the metric must survive clock steps
             group = None
             outcome = "error"  # overwritten on a normal return; errors stay visible
-            try:
-                group = await self._search_until_deadline()
-                outcome = "assembled" if group is not None else "expired"
-                self._record_round_outcome(
-                    get_dht_time() - search_started if group is not None else None
-                )
-                return group
-            except asyncio.CancelledError:
-                outcome = "cancelled"  # control.cancel / shutdown: not an error
-                raise
-            finally:
-                _MATCHMAKING_WAIT.observe(time.perf_counter() - wait_started, outcome=outcome)
-                _MATCHMAKING_ROUNDS.inc(outcome=outcome)
-                if group is not None:
-                    _GROUP_SIZE.set(len(group.peer_ids))
-                self.looking_for_group = False
-                self.current_leader = None
-                if declare_task is not None:
-                    await cancel_and_wait(declare_task)
-                    with contextlib.suppress(Exception):
-                        # retract under the key we DECLARED under, not the new bucket
-                        await self.key_manager.declare_averager(
-                            declared_key, self.peer_id, get_dht_time(), looking_for_group=False
-                        )
-                if self.current_followers and self.assembled_group is None:
-                    self._disband_followers(suggested_leader=None)
+            # the with block (not manual enter/exit) so an unexpected exception
+            # leaves its `error` event on the span; cleanup runs inside it — the
+            # retract/disband time is part of the round's wall time
+            with _tracing_span("averaging.matchmaking", peer=str(self.peer_id)) as match_span:
+                try:
+                    group = await self._search_until_deadline()
+                    outcome = "assembled" if group is not None else "expired"
+                    self._record_round_outcome(
+                        get_dht_time() - search_started if group is not None else None
+                    )
+                    return group
+                except asyncio.CancelledError:
+                    outcome = "cancelled"  # control.cancel / shutdown: not an error
+                    raise
+                finally:
+                    if match_span is not None:
+                        match_span.set("outcome", outcome)
+                        if group is not None:
+                            match_span.set("group_size", len(group.peer_ids))
+                    _MATCHMAKING_WAIT.observe(time.perf_counter() - wait_started, outcome=outcome)
+                    _MATCHMAKING_ROUNDS.inc(outcome=outcome)
+                    if group is not None:
+                        _GROUP_SIZE.set(len(group.peer_ids))
+                    self.looking_for_group = False
+                    self.current_leader = None
+                    if declare_task is not None:
+                        await cancel_and_wait(declare_task)
+                        with contextlib.suppress(Exception):
+                            # retract under the key we DECLARED under, not the new bucket
+                            await self.key_manager.declare_averager(
+                                declared_key, self.peer_id, get_dht_time(), looking_for_group=False
+                            )
+                    if self.current_followers and self.assembled_group is None:
+                        self._disband_followers(suggested_leader=None)
 
     async def _declare_periodically(self, key: str) -> None:
         # sleep FIRST: look_for_group already stored the initial declaration

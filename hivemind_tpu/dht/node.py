@@ -50,6 +50,13 @@ DEFAULT_NUM_WORKERS = int(os.getenv("HIVEMIND_TPU_DHT_NUM_WORKERS", "4"))
 # store/get latency as seen by DHT users — distinct from the per-RPC timings in
 # dht/protocol.py, which measure single peer round-trips
 from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
+from hivemind_tpu.telemetry.tracing import (
+    finish_span as _finish_span,
+    install_span as _install_span,
+    start_span as _start_span,
+    trace as _tracing_span,
+    uninstall_span as _uninstall_span,
+)
 
 _DHT_OP_LATENCY = _TELEMETRY.histogram(
     "hivemind_dht_operation_latency_seconds", "store_many/get_many wall time", ("op",)
@@ -319,6 +326,14 @@ class DHTNode:
         including self), and store with per-subkey records + validator signatures
         (reference node.py:351-503)."""
         started = time.perf_counter()
+        with _tracing_span("dht.store", peer=str(self.protocol.p2p.peer_id), keys=len(keys)):
+            return await self._store_many_traced(
+                keys, values, expiration_time, subkeys, exclude_self, await_all_replicas, started
+            )
+
+    async def _store_many_traced(
+        self, keys, values, expiration_time, subkeys, exclude_self, await_all_replicas, started
+    ) -> Dict[Any, bool]:
         if isinstance(expiration_time, (int, float)):
             expiration_time = [expiration_time] * len(keys)
         if subkeys is None:
@@ -428,6 +443,25 @@ class DHTNode:
         ``return_futures``, each value is a future resolved when that key finishes
         (reference node.py:534-678)."""
         started = time.perf_counter()
+        # manual span install: in futures mode the op outlives this coroutine,
+        # so the span is finished from the same done-callback that feeds the
+        # latency metric; traversal tasks created below inherit the span
+        op_span = _start_span(
+            "dht.get", peer=str(self.protocol.p2p.peer_id), keys=len(list(key_ids))
+        )
+        span_token = _install_span(op_span)
+        try:
+            return await self._get_many_by_id_traced(
+                key_ids, sufficient_expiration_time, num_workers, beam_size,
+                return_futures, _is_refresh, started, op_span,
+            )
+        finally:
+            _uninstall_span(span_token)
+
+    async def _get_many_by_id_traced(
+        self, key_ids, sufficient_expiration_time, num_workers, beam_size,
+        return_futures, _is_refresh, started, op_span,
+    ) -> Dict[DHTID, Union[Optional[ValueWithExpiration], Awaitable]]:
         key_ids = list(key_ids)
         if sufficient_expiration_time is None:
             sufficient_expiration_time = get_dht_time()
@@ -502,12 +536,15 @@ class DHTNode:
             watcher = asyncio.gather(
                 *(reused.get(kid, futures[kid]) for kid in key_ids), return_exceptions=True
             )
-            watcher.add_done_callback(
-                lambda _w: _DHT_OP_LATENCY.observe(time.perf_counter() - started, op="get")
-            )
+            def _observe_get(_w) -> None:
+                _DHT_OP_LATENCY.observe(time.perf_counter() - started, op="get")
+                _finish_span(op_span)
+
+            watcher.add_done_callback(_observe_get)
             return output
         gathered = await asyncio.gather(*(reused.get(kid, futures[kid]) for kid in key_ids))
         _DHT_OP_LATENCY.observe(time.perf_counter() - started, op="get")
+        _finish_span(op_span)
         return dict(zip(key_ids, gathered))
 
     def _finalize_get(

@@ -26,6 +26,7 @@ import time
 from typing import Dict, List, Optional
 
 from hivemind_tpu.resilience import CHAOS, INJECTION_POINTS, reset_all_boards
+from hivemind_tpu.telemetry.tracing import RECORDER
 from hivemind_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -95,6 +96,10 @@ def run_soak(
         "n_peers": n_peers, "duration": duration, "seed": seed, "errors": [],
     }
     reset_all_boards()
+    # arm the flight recorder for THIS soak: a fresh ring means every chaos
+    # span event found at verdict time was injected by this run (ISSUE 4: the
+    # chaos engine and the tracer must provably connect)
+    RECORDER.clear()
     # the soak's recovery window is short: expert breakers must be probeable
     # within it (the production default is restored in the outer finally)
     original_expert_recovery = EXPERT_BREAKERS._kwargs["recovery_time"]
@@ -213,6 +218,13 @@ def run_soak(
             steps_at_chaos_end = dict(step_counts)
             report["chaos_stats"] = CHAOS.stats()
             points_exercised = {rule.point for rule in CHAOS.rules if rule.calls > 0}
+            # count injected faults visible in the trace NOW, before the
+            # recovery phase's spans can evict the chaos-era ones from the ring
+            chaos_span_events = sum(
+                sum(1 for _t, name, _a in span.events or () if name.startswith("chaos."))
+                for span in RECORDER.snapshot()
+            )
+            report["chaos_span_events"] = chaos_span_events
             CHAOS.clear()
             chaos_off_event.set()
             logger.warning("chaos window over: faults disarmed, watching recovery")
@@ -265,6 +277,9 @@ def run_soak(
             "breakers_recovered": not report["breakers_still_tripped"],
             "every_point_exercised": not missed_points,
             "faults_injected": total_injections >= 10,
+            # the loop between the chaos engine and the flight recorder: at
+            # least one injected fault must be visible as a span event
+            "chaos_visible_in_trace": report.get("chaos_span_events", 0) >= 1,
             "no_thread_errors": not errors,
         }
         if include_moe:

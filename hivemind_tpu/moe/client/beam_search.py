@@ -14,6 +14,7 @@ import numpy as np
 from hivemind_tpu.dht import DHT
 from hivemind_tpu.moe.expert_uid import UID_DELIMITER, ExpertInfo, is_valid_prefix
 from hivemind_tpu.p2p import PeerID
+from hivemind_tpu.telemetry.tracing import trace as _tracing_span
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.timed_storage import TimedStorage, get_dht_time
 
@@ -62,6 +63,15 @@ class MoEBeamSearcher:
         return self.dht.run_coroutine(_search)
 
     async def _find_best_experts_async(self, node, scores, beam_size: int) -> List[List[ExpertInfo]]:
+        with _tracing_span(
+            "moe.beam_search",
+            peer=str(node.protocol.p2p.peer_id),
+            prefix=self.uid_prefix,
+            beam_size=beam_size,
+        ):
+            return await self._beam_search_traced(node, scores, beam_size)
+
+    async def _beam_search_traced(self, node, scores, beam_size: int) -> List[List[ExpertInfo]]:
         batch_size = scores[0].shape[0]
         # per-sample beams: list of (neg_total_score, prefix_without_trailing_delim)
         beams: List[List[Tuple[float, str]]] = [

@@ -27,6 +27,7 @@ import numpy as np
 from hivemind_tpu.moe.client.expert import RemoteExpert
 from hivemind_tpu.resilience import CHAOS as _CHAOS
 from hivemind_tpu.resilience import BreakerBoard, BreakerOpenError
+from hivemind_tpu.telemetry.tracing import trace as _tracing_span
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.loop import get_loop_runner
 from hivemind_tpu.utils.timed_storage import get_dht_time
@@ -95,13 +96,23 @@ class RemoteCallMany:
         each outcome (resilience/breaker.py)."""
 
         async def _guarded_call(uid: str):
-            if not EXPERT_BREAKERS.allow(uid):
-                raise BreakerOpenError(f"expert {uid} breaker is open; skipping")
-            if _CHAOS.enabled:  # injection point: per expert forward/backward RPC
-                await _CHAOS.inject(chaos_point, scope=uid)
-            result = await make_call(self.jobs[uid][0], uid)
-            EXPERT_BREAKERS.register_success(uid)
-            return result
+            # one span per expert RPC ("moe.forward"/"moe.backward" — the chaos
+            # point names double as span names so an injected fault is
+            # attributable to the exact expert call it hit)
+            expert = self.jobs[uid][0]
+            with _tracing_span(
+                chaos_point,
+                expert=uid,
+                peer=str(expert.p2p.peer_id),
+                remote=str(expert.peer_id),
+            ):
+                if not EXPERT_BREAKERS.allow(uid):
+                    raise BreakerOpenError(f"expert {uid} breaker is open; skipping")
+                if _CHAOS.enabled:  # injection point: per expert forward/backward RPC
+                    await _CHAOS.inject(chaos_point, scope=uid)
+                result = await make_call(expert, uid)
+                EXPERT_BREAKERS.register_success(uid)
+                return result
 
         loop_tasks = {
             asyncio.ensure_future(_guarded_call(uid)): uid for uid in job_uids

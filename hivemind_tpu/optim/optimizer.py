@@ -32,6 +32,7 @@ from hivemind_tpu.optim.chronic import ChronicFailureTracking
 from hivemind_tpu.optim.grad_averager import GradientAverager
 from hivemind_tpu.optim.progress_tracker import ProgressTracker
 from hivemind_tpu.optim.state_averager import TrainingStateAverager
+from hivemind_tpu.telemetry.tracing import trace as _tracing_span
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.timed_storage import get_dht_time
 
@@ -216,18 +217,21 @@ class Optimizer(ChronicFailureTracking):
     ) -> Any:
         """Report progress, accumulate gradients, and run the collaborative update
         when the swarm is ready. Returns the (possibly updated) parameter pytree."""
-        if self.auxiliary:
-            self._auxiliary_step()
-            return None
-        assert self.state_averager is not None
-        with self._step_lock:
-            if self._should_load_state_from_peers():
-                self._catch_up_with_swarm()
+        # layer-5 span: the whole-step host timeline — a slow step's trace shows
+        # WHICH child (catch-up, averaging round, state load) ate the time
+        with _tracing_span("optimizer.step", peer=str(self.dht.peer_id), epoch=self.local_epoch):
+            if self.auxiliary:
+                self._auxiliary_step()
+                return None
+            assert self.state_averager is not None
+            with self._step_lock:
+                if self._should_load_state_from_peers():
+                    self._catch_up_with_swarm()
 
-            batch_size = batch_size if batch_size is not None else (self.batch_size_per_step or 1)
-            if self.use_local_updates:
-                return self._local_updates_step(grads, batch_size)
-            return self._collaborative_step(grads, batch_size)
+                batch_size = batch_size if batch_size is not None else (self.batch_size_per_step or 1)
+                if self.use_local_updates:
+                    return self._local_updates_step(grads, batch_size)
+                return self._collaborative_step(grads, batch_size)
 
     def _collaborative_step(self, grads: Any, batch_size: int) -> Any:
         assert self.grad_averager is not None and self.state_averager is not None

@@ -7,8 +7,12 @@ encrypted TCP connection. Frames are whole messages (an RPC message = one frame)
 removes the reference's 8-byte-header + marker reframing layer entirely.
 
 Mux frame layout (inside the AEAD envelope): [u64 stream_id][u8 flags][payload].
-Flags: OPEN (payload = handler name utf-8), DATA (payload = message), CLOSE (graceful
-end-of-stream from that side), RESET (abort), ERROR (payload = msgpack error info).
+Flags: OPEN (payload = handler name utf-8, optionally followed by NUL + a 16-byte
+trace context — handler names never contain NUL), DATA (payload = message), CLOSE
+(graceful end-of-stream from that side), RESET (abort), ERROR (payload = msgpack
+error info). The trace context (telemetry/tracing.py pack_context) is how a
+server-side handler span becomes a child of the remote caller's span; absent
+when the caller has no active span, ignored when malformed.
 Flow control: per-stream inboxes are unbounded (the read loop never head-of-line-blocks
 one stream on another), with a per-connection buffered-bytes cap as the memory backstop
 — a peer that overruns it loses the connection, not the process. TCP backpressure plus
@@ -24,6 +28,7 @@ from enum import IntFlag
 from typing import AsyncIterator, Awaitable, Callable, Dict, Optional
 
 from hivemind_tpu.p2p.crypto_channel import SecureChannel
+from hivemind_tpu.telemetry.tracing import unpack_context
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.serializer import MSGPackSerializer
 
@@ -72,6 +77,7 @@ class MuxStream:
         self._conn = conn
         self.stream_id = stream_id
         self.handler_name = handler_name
+        self.trace_context = None  # (trace_id, span_id) from the remote OPEN, if any
         self._inbox: asyncio.Queue = asyncio.Queue()
         self._recv_closed = False
         self._send_closed = False
@@ -193,14 +199,19 @@ class MuxConnection:
     def is_closed(self) -> bool:
         return self._closed
 
-    async def open_stream(self, handler_name: str) -> MuxStream:
+    async def open_stream(
+        self, handler_name: str, trace_context: Optional[bytes] = None
+    ) -> MuxStream:
         if self._closed:
             raise StreamClosedError(f"connection to {self.peer_id} is closed")
         stream_id = self._next_stream_id
         self._next_stream_id += 2
         stream = MuxStream(self, stream_id, handler_name)
         self._streams[stream_id] = stream
-        await self.send_frame(stream_id, Flags.OPEN, handler_name.encode("utf-8"))
+        payload = handler_name.encode("utf-8")
+        if trace_context is not None:
+            payload += b"\x00" + trace_context
+        await self.send_frame(stream_id, Flags.OPEN, payload)
         return stream
 
     @property
@@ -250,8 +261,11 @@ class MuxConnection:
                 )
                 await self.send_frame(stream_id, Flags.RESET, b"")
                 return
-            handler_name = payload.decode("utf-8", errors="replace")
+            name_bytes, _nul, trace_raw = payload.partition(b"\x00")
+            handler_name = name_bytes.decode("utf-8", errors="replace")
             stream = MuxStream(self, stream_id, handler_name)
+            if trace_raw:
+                stream.trace_context = unpack_context(trace_raw)
             self._streams[stream_id] = stream
             task = asyncio.create_task(self._on_inbound_stream(stream))
             self._handler_tasks.add(task)

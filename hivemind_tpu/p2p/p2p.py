@@ -55,6 +55,11 @@ TResponse = TypeVar("TResponse")
 # bytes and failures on both sides of the wire. label `side`: "server" for
 # handlers this peer serves, "client" for calls it makes.
 from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
+from hivemind_tpu.telemetry.tracing import (
+    finish_span as _finish_span,
+    start_span as _start_span,
+    trace as _trace,
+)
 
 _RPC_LATENCY = _TELEMETRY.histogram(
     "hivemind_p2p_rpc_latency_seconds", "wall time of one RPC", ("handler", "side")
@@ -746,6 +751,16 @@ class P2P:
         context = P2PContext(stream.handler_name, self.peer_id, stream.peer_id)
         started = time.perf_counter()
         bytes_in = bytes_out = 0
+        # the OPEN frame may carry the remote caller's trace context: this
+        # handler span then joins the caller's trace as a child, which is what
+        # makes a cross-peer timeline reconstructable from per-peer recorders
+        handler_trace = _trace(
+            f"p2p.handle:{stream.handler_name}",
+            remote_context=stream.trace_context,
+            peer=str(self.peer_id),
+            remote=str(stream.peer_id),
+        )
+        handler_trace.__enter__()
         try:
             if handler.stream_input:
                 async def _counted_stream():
@@ -780,6 +795,8 @@ class P2P:
             raise
         except Exception as e:
             _RPC_ERRORS.inc(handler=stream.handler_name, side="server")
+            if handler_trace.span is not None:
+                handler_trace.span.add_event("error", type=type(e).__name__)
             logger.debug(f"handler {stream.handler_name} failed: {e!r}")
             try:
                 await stream.send_error(e)
@@ -787,6 +804,7 @@ class P2P:
             except StreamClosedError:
                 pass
         finally:
+            handler_trace.__exit__(None, None, None)
             _RPC_LATENCY.observe(time.perf_counter() - started, handler=stream.handler_name, side="server")
             if bytes_in:
                 _RPC_BYTES.inc(bytes_in, handler=stream.handler_name, direction="in")
@@ -795,17 +813,19 @@ class P2P:
 
     # ------------------------------------------------------------------ calls
 
-    async def _open_stream_with_redial(self, peer_id: PeerID, name: str) -> MuxStream:
+    async def _open_stream_with_redial(
+        self, peer_id: PeerID, name: str, trace_context: Optional[bytes] = None
+    ) -> MuxStream:
         """Open a stream, re-dialing once if the cached connection died between
         lookup and use (e.g. the connection manager trimmed it, or the peer
         restarted) — a trimmed idle connection must look like a cache miss, not
         an RPC failure."""
         conn = await self._get_connection(peer_id)
         try:
-            return await conn.open_stream(name)
+            return await conn.open_stream(name, trace_context)
         except StreamClosedError:
             conn = await self._get_connection(peer_id)
-            return await conn.open_stream(name)
+            return await conn.open_stream(name, trace_context)
 
     async def call_protobuf_handler(
         self,
@@ -828,51 +848,58 @@ class P2P:
         """
         payload = _serialize(request)
         started = time.perf_counter()
-        try:
-            if _CHAOS.enabled:  # injection point: drop/delay/corrupt the outbound request
-                payload = await _CHAOS.inject("p2p.unary.send", payload=payload, scope=str(self.peer_id))
-            for attempt in range(2):
-                stream = await self._open_stream_with_redial(peer_id, name)
-                try:
+        # client span: a child of whatever operation issued this RPC; its
+        # (trace_id, span_id) ride the OPEN frame so the remote handler span
+        # joins the same trace one level down. The with block (not manual
+        # enter/exit) so a failed call carries its `error` event.
+        with _trace(f"p2p.call:{name}", peer=str(self.peer_id), remote=str(peer_id)) as call_span:
+            try:
+                if _CHAOS.enabled:  # injection point: drop/delay/corrupt the outbound request
+                    payload = await _CHAOS.inject("p2p.unary.send", payload=payload, scope=str(self.peer_id))
+                for attempt in range(2):
+                    stream = await self._open_stream_with_redial(
+                        peer_id, name, None if call_span is None else call_span.context_bytes()
+                    )
                     try:
-                        await stream.send(payload)
-                        await stream.close_send()
-                    except StreamClosedError:
-                        # the request never left: safe to retry for any RPC
-                        if attempt == 0:
-                            continue
-                        raise P2PHandlerError(f"{name}: connection closed before request was sent") from None
-                    try:
-                        response = await stream.receive()
-                    except RemoteError as e:
-                        raise P2PHandlerError(str(e)) from e
-                    except StreamClosedError:
-                        # nothing was received, but the request WAS sent: the peer may
-                        # or may not have processed it. Only retry when the caller
-                        # declared the RPC idempotent (reads: rpc_info, DHT ping/find,
-                        # or set-semantics writes like rpc_store).
-                        if idempotent and attempt == 0 and stream._conn.is_closed:
-                            continue
-                        raise P2PHandlerError(
-                            f"{name}: stream closed before response"
-                            + ("" if idempotent else " (not retried: RPC not marked idempotent)")
-                        ) from None
-                    if _CHAOS.enabled:  # injection point: lose/corrupt the response
-                        response = await _CHAOS.inject(
-                            "p2p.unary.recv", payload=response, scope=str(self.peer_id)
-                        )
-                    _RPC_BYTES.inc(len(payload), handler=name, direction="out")
-                    _RPC_BYTES.inc(len(response), handler=name, direction="in")
-                    return _parse(response, response_type)
-                finally:
-                    await stream.reset()
-        except asyncio.CancelledError:
-            raise
-        except BaseException:
-            _RPC_ERRORS.inc(handler=name, side="client")
-            raise
-        finally:
-            _RPC_LATENCY.observe(time.perf_counter() - started, handler=name, side="client")
+                        try:
+                            await stream.send(payload)
+                            await stream.close_send()
+                        except StreamClosedError:
+                            # the request never left: safe to retry for any RPC
+                            if attempt == 0:
+                                continue
+                            raise P2PHandlerError(f"{name}: connection closed before request was sent") from None
+                        try:
+                            response = await stream.receive()
+                        except RemoteError as e:
+                            raise P2PHandlerError(str(e)) from e
+                        except StreamClosedError:
+                            # nothing was received, but the request WAS sent: the peer may
+                            # or may not have processed it. Only retry when the caller
+                            # declared the RPC idempotent (reads: rpc_info, DHT ping/find,
+                            # or set-semantics writes like rpc_store).
+                            if idempotent and attempt == 0 and stream._conn.is_closed:
+                                continue
+                            raise P2PHandlerError(
+                                f"{name}: stream closed before response"
+                                + ("" if idempotent else " (not retried: RPC not marked idempotent)")
+                            ) from None
+                        if _CHAOS.enabled:  # injection point: lose/corrupt the response
+                            response = await _CHAOS.inject(
+                                "p2p.unary.recv", payload=response, scope=str(self.peer_id)
+                            )
+                        _RPC_BYTES.inc(len(payload), handler=name, direction="out")
+                        _RPC_BYTES.inc(len(response), handler=name, direction="in")
+                        return _parse(response, response_type)
+                    finally:
+                        await stream.reset()
+            except asyncio.CancelledError:
+                raise
+            except BaseException:
+                _RPC_ERRORS.inc(handler=name, side="client")
+                raise
+            finally:
+                _RPC_LATENCY.observe(time.perf_counter() - started, handler=name, side="client")
 
     async def iterate_protobuf_handler(
         self,
@@ -883,7 +910,16 @@ class P2P:
     ) -> AsyncIterator:
         """Streaming call: ``requests`` is one message or an async iterator of them;
         yields response messages until the remote closes."""
-        stream = await self._open_stream_with_redial(peer_id, name)
+        # a detached span (start_span, not trace): an async generator's body runs
+        # in its consumer's context, so installing a contextvar here would leak
+        # the span into the consumer between yields. It still parents to the
+        # caller's current span and propagates its context to the remote handler.
+        stream_span = _start_span(
+            f"p2p.stream:{name}", peer=str(self.peer_id), remote=str(peer_id)
+        )
+        stream = await self._open_stream_with_redial(
+            peer_id, name, None if stream_span is None else stream_span.context_bytes()
+        )
 
         async def _feed():
             nonlocal bytes_out
@@ -937,6 +973,7 @@ class P2P:
                 yield _parse(message, response_type)
         finally:
             feeder.cancel()
+            _finish_span(stream_span)
             _RPC_LATENCY.observe(time.perf_counter() - started, handler=name, side="client")
             if bytes_in:
                 _RPC_BYTES.inc(bytes_in, handler=name, direction="in")

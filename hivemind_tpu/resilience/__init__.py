@@ -7,6 +7,7 @@ from hivemind_tpu.resilience.breaker import (
     BreakerOpenError,
     BreakerState,
     CircuitBreaker,
+    all_board_states,
     reset_all_boards,
 )
 from hivemind_tpu.resilience.chaos import (
@@ -35,5 +36,6 @@ __all__ = [
     "DeadlineExceeded",
     "INJECTION_POINTS",
     "RetryPolicy",
+    "all_board_states",
     "reset_all_boards",
 ]

@@ -43,6 +43,7 @@ from collections import OrderedDict
 from typing import Callable, Dict, Hashable, Optional
 
 from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
+from hivemind_tpu.telemetry.tracing import current_span as _current_span
 
 _BREAKER_TRIPS = _TELEMETRY.counter(
     "hivemind_breaker_trips_total", "circuit-breaker trips (-> open)", ("board",)
@@ -258,6 +259,15 @@ class BreakerBoard:
                 self._note_tripped(key)
             if probe_outcome is not None:
                 _BREAKER_PROBES.inc(board=self.name, outcome=probe_outcome)
+        if tripped_now or probe_outcome is not None:
+            # trips and failed probes are trace-worthy: the operation that
+            # tripped the breaker carries the event on its active span
+            span = _current_span()
+            if span is not None:
+                if tripped_now:
+                    span.add_event("breaker.trip", board=self.name, key=str(key))
+                if probe_outcome is not None:
+                    span.add_event("breaker.probe", board=self.name, key=str(key), outcome=probe_outcome)
 
     def register_success(self, key: Hashable) -> None:
         with self._lock:
@@ -268,6 +278,10 @@ class BreakerBoard:
             if probe_outcome is not None:
                 _BREAKER_PROBES.inc(board=self.name, outcome=probe_outcome)
             self._note_recovered(key)
+        if probe_outcome is not None:
+            span = _current_span()
+            if span is not None:
+                span.add_event("breaker.probe", board=self.name, key=str(key), outcome=probe_outcome)
 
     def allow(self, key: Hashable) -> bool:
         """Probe-admission check (mutating in half-open): call ONCE per request."""
@@ -333,3 +347,15 @@ def reset_all_boards() -> None:
     """Clear every live board (test isolation: boards are often module-level)."""
     for board in list(_ALL_BOARDS):
         board.clear()
+
+
+def all_board_states() -> Dict[str, Dict[str, object]]:
+    """Compact health view of every live board — what the DHT-published peer
+    snapshot carries so the swarm monitor can show WHICH peers are degraded,
+    not just their counters. Only boards with any tripped key appear."""
+    out: Dict[str, Dict[str, object]] = {}
+    for board in list(_ALL_BOARDS):
+        tripped = [str(key) for key in board.tripped_keys()]
+        if tripped:
+            out[board.name] = {"tripped": sorted(tripped)[:16], "num_tripped": len(tripped)}
+    return out

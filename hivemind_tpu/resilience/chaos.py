@@ -54,6 +54,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
+from hivemind_tpu.telemetry.tracing import current_span as _current_span
 from hivemind_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -232,6 +233,11 @@ class ChaosEngine:
             if not rule.matches(point, scope) or not rule.decide():
                 continue
             _CHAOS_INJECTIONS.inc(point=point, action=rule.action)
+            # the injected fault becomes visible IN the trace at the exact
+            # operation it hit: the active span carries a chaos.<action> event
+            span = _current_span()
+            if span is not None:
+                span.add_event(f"chaos.{rule.action}", point=point)
             if rule.action == "drop":
                 raise ChaosDrop(f"chaos: dropped at {point}")
             if rule.action == "abort":

@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Optional, Tuple, Type, TypeVar
 
 from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
+from hivemind_tpu.telemetry.tracing import current_span as _current_span
 
 T = TypeVar("T")
 
@@ -151,9 +152,17 @@ class RetryPolicy:
             return raw / 2.0 + raw / 2.0 * rand
         return raw
 
-    def _account_retry(self) -> None:
+    def _account_retry(self, retry_index: int = 0, exc: Optional[BaseException] = None) -> None:
         if self.name is not None:
             _RETRIES.inc(site=self.name)
+        span = _current_span()
+        if span is not None:  # the retried operation's span shows each attempt
+            span.add_event(
+                "retry",
+                site=self.name or "anonymous",
+                attempt=retry_index + 1,
+                error=type(exc).__name__ if exc is not None else "",
+            )
 
     async def execute(
         self,
@@ -177,7 +186,7 @@ class RetryPolicy:
                     raise
                 if deadline is not None and deadline.expired:
                     raise
-                self._account_retry()
+                self._account_retry(retry_index, e)
                 if on_retry is not None:
                     on_retry(retry_index, e)
                 sleep = self.delay(retry_index, rng)
@@ -205,7 +214,7 @@ class RetryPolicy:
                     raise
                 if self.max_attempts is not None and retry_index + 1 >= self.max_attempts:
                     raise
-                self._account_retry()
+                self._account_retry(retry_index, e)
                 if on_retry is not None:
                     on_retry(retry_index, e)
                 sleep(self.delay(retry_index, rng))

@@ -1,17 +1,32 @@
-"""Swarm-wide telemetry (ISSUE 2): a zero-dependency, thread-safe metrics
-registry with a Prometheus text exporter and DHT-published peer snapshots.
+"""Swarm-wide telemetry (ISSUE 2 + 4): a zero-dependency, thread-safe metrics
+registry with a Prometheus text exporter, DHT-published peer snapshots, and
+distributed tracing with a per-process flight recorder.
 
 - :mod:`~hivemind_tpu.telemetry.registry` — Counter / Gauge / Histogram with
   labels; the process-wide :data:`REGISTRY` all layers record into.
-- :mod:`~hivemind_tpu.telemetry.exporter` — ``GET /metrics`` over stdlib HTTP
-  (``--metrics-port`` in run_server.py / run_dht.py).
+- :mod:`~hivemind_tpu.telemetry.tracing` — cross-peer spans, the
+  :data:`~hivemind_tpu.telemetry.tracing.RECORDER` ring buffer, and
+  Chrome-trace/Perfetto export.
+- :mod:`~hivemind_tpu.telemetry.exporter` — ``GET /metrics`` + ``GET /trace``
+  over stdlib HTTP (``--metrics-port`` in run_server.py / run_dht.py).
 - :mod:`~hivemind_tpu.telemetry.monitor` — per-peer DHT snapshot publisher and
-  the swarm-wide aggregation view.
+  the swarm-wide aggregation view (now incl. breaker states + slow spans).
 
-See docs/observability.md for the metric catalog.
+See docs/observability.md for the metric catalog and the span catalog.
 """
 
 from hivemind_tpu.telemetry.exporter import MetricsExporter, render_prometheus
+from hivemind_tpu.telemetry.tracing import (
+    RECORDER,
+    Span,
+    SpanRecorder,
+    current_span,
+    finish_span,
+    render_chrome_trace,
+    set_slow_span_threshold,
+    start_span,
+    trace,
+)
 from hivemind_tpu.telemetry.monitor import (
     DEFAULT_TELEMETRY_KEY,
     SwarmMonitor,
@@ -31,8 +46,17 @@ from hivemind_tpu.telemetry.registry import (
 
 __all__ = [
     "REGISTRY",
+    "RECORDER",
     "DEFAULT_BUCKETS",
     "DEFAULT_TELEMETRY_KEY",
+    "Span",
+    "SpanRecorder",
+    "trace",
+    "current_span",
+    "start_span",
+    "finish_span",
+    "render_chrome_trace",
+    "set_slow_span_threshold",
     "Counter",
     "Gauge",
     "Histogram",

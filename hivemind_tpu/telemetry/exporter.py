@@ -15,6 +15,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from hivemind_tpu.telemetry.registry import REGISTRY, MetricsRegistry
+from hivemind_tpu.telemetry.tracing import RECORDER, SpanRecorder, render_chrome_trace
 from hivemind_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -74,6 +75,7 @@ def render_prometheus(registry: MetricsRegistry = REGISTRY) -> str:
 
 class _MetricsHandler(BaseHTTPRequestHandler):
     registry: MetricsRegistry = REGISTRY  # overridden per-server
+    recorder: SpanRecorder = RECORDER  # overridden per-server
 
     def do_GET(self):  # noqa: N802 (stdlib API)
         path = self.path.split("?", 1)[0]
@@ -83,6 +85,13 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             self.send_header("Content-Type", CONTENT_TYPE)
         elif path == "/metrics.json":
             body = json.dumps(self.registry.snapshot()).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+        elif path == "/trace":
+            # the flight recorder as Chrome trace-event JSON: save the response
+            # to a file and open it in Perfetto / chrome://tracing (one pid row
+            # per peer; serialization happens HERE, never on the record path)
+            body = json.dumps(render_chrome_trace(self.recorder.snapshot()), default=str).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
         elif path == "/healthz":
@@ -102,8 +111,9 @@ class _MetricsHandler(BaseHTTPRequestHandler):
 
 
 class MetricsExporter:
-    """Serve ``/metrics`` (Prometheus text), ``/metrics.json`` (compact snapshot)
-    and ``/healthz`` on a daemon thread.
+    """Serve ``/metrics`` (Prometheus text), ``/metrics.json`` (compact
+    snapshot), ``/trace`` (Chrome trace-event JSON from the span flight
+    recorder) and ``/healthz`` on a daemon thread.
 
     :param port: TCP port; 0 picks a free one (read it back via ``.port``)
     :param host: bind host; default loopback — pass "0.0.0.0" for remote scrapers
@@ -114,10 +124,14 @@ class MetricsExporter:
         port: int = 0,
         host: str = "127.0.0.1",
         registry: MetricsRegistry = REGISTRY,
+        recorder: SpanRecorder = RECORDER,
         start: bool = True,
     ):
         self.registry = registry
-        handler = type("_BoundMetricsHandler", (_MetricsHandler,), {"registry": registry})
+        self.recorder = recorder
+        handler = type(
+            "_BoundMetricsHandler", (_MetricsHandler,), {"registry": registry, "recorder": recorder}
+        )
         self._server = ThreadingHTTPServer((host, port), handler)
         self._server.daemon_threads = True
         self._thread: Optional[threading.Thread] = None

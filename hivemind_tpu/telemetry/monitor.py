@@ -33,11 +33,29 @@ _MAX_SNAPSHOT_BYTES = 48 * 1024
 def build_peer_snapshot(
     registry: MetricsRegistry = REGISTRY, extras: Optional[Dict[str, Any]] = None
 ) -> Dict[str, Any]:
-    """One peer's compact telemetry record (msgpack/JSON-able)."""
-    snapshot = {
+    """One peer's compact telemetry record (msgpack/JSON-able). Besides the
+    metric snapshot it carries the peer's *health* — tripped breaker boards and
+    the last few slow spans — plus recent span summaries, so the swarm monitor
+    can show which peers are degraded and reconstruct a cross-peer timeline
+    without scraping every peer's ``/trace`` endpoint."""
+    # lazy import: telemetry must stay importable before resilience (which
+    # itself imports this package for its metrics)
+    from hivemind_tpu.resilience import all_board_states
+    from hivemind_tpu.telemetry.tracing import RECORDER
+
+    snapshot: Dict[str, Any] = {
         "time": get_dht_time(),
         "metrics": registry.snapshot(),
     }
+    breakers = all_board_states()
+    if breakers:
+        snapshot["breakers"] = breakers
+    slow = RECORDER.slow_spans()
+    if slow:
+        snapshot["slow_spans"] = [span.summary() for span in slow[-5:]]
+    recent = RECORDER.summaries(limit=30)
+    if recent:
+        snapshot["recent_spans"] = recent
     if extras:
         snapshot.update(extras)
     return snapshot
@@ -48,6 +66,13 @@ def _shrink_to_fit(snapshot: Dict[str, Any], max_bytes: int = _MAX_SNAPSHOT_BYTE
 
     if len(MSGPackSerializer.dumps(snapshot)) <= max_bytes:
         return snapshot
+    # span summaries are nice-to-have context; the health + counter core wins
+    for optional_key in ("recent_spans", "slow_spans"):
+        if optional_key in snapshot:
+            snapshot = {k: v for k, v in snapshot.items() if k != optional_key}
+            snapshot["truncated"] = True
+            if len(MSGPackSerializer.dumps(snapshot)) <= max_bytes:
+                return snapshot
     metrics = dict(snapshot.get("metrics", {}))
     # histograms are the bulky families; their count/sum alone usually suffices
     # for the swarm view, so drop the largest families until the record fits
@@ -165,7 +190,8 @@ def aggregate_swarm_view(records: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
     for peer, snapshot in records.items():
         peers[peer] = {
             "age_s": round(max(now - float(snapshot.get("time", now)), 0.0), 1),
-            **{k: v for k, v in snapshot.items() if k not in ("metrics", "time", "peer_id")},
+            # recent_spans feed render_timeline, not the per-peer health line
+            **{k: v for k, v in snapshot.items() if k not in ("metrics", "time", "peer_id", "recent_spans")},
         }
         for name, family in (snapshot.get("metrics") or {}).items():
             ftype = family.get("type", "untyped")
@@ -205,7 +231,9 @@ class SwarmMonitor:
         return view
 
     def render_report(self, view: Optional[Dict[str, Any]] = None) -> str:
-        """Human-readable one-screen summary for log lines / CLIs."""
+        """Human-readable one-screen summary for log lines / CLIs. Peers whose
+        snapshot carries tripped breakers or slow spans are flagged DEGRADED —
+        the "which peer is the problem" line, not just its counters."""
         view = view if view is not None else self.poll()
         lines = [f"swarm telemetry: {view['num_peers']} peers"]
         for name, agg in sorted(view.get("metrics", {}).items()):
@@ -216,5 +244,43 @@ class SwarmMonitor:
                 extra += f", min={agg['min']}, max={agg['max']}"
             lines.append(f"  {name} [{agg['type']}] total={agg['total']}{extra} ({agg['peers']} peers)")
         for peer, health in sorted(view.get("peers", {}).items()):
-            lines.append(f"  peer {peer[:16]}…: {health}")
+            breakers = health.get("breakers") or {}
+            slow = health.get("slow_spans") or []
+            marker = " DEGRADED" if breakers or slow else ""
+            lines.append(f"  peer {peer[:16]}…:{marker} {health}")
+            for board, state in sorted(breakers.items()):
+                lines.append(f"    breaker {board}: {state.get('num_tripped', 0)} tripped {state.get('tripped')}")
+            for span in slow:
+                lines.append(
+                    f"    slow span {span.get('name')}: {span.get('dur_ms')}ms events={span.get('events', [])}"
+                )
+        return "\n".join(lines)
+
+    def render_timeline(self, records: Optional[Dict[str, Dict[str, Any]]] = None) -> str:
+        """Cross-peer timeline: pull every peer's recent span summaries from the
+        DHT, group them by trace, and print each trace's spans in start order —
+        one line per span, labeled with the owning peer. This is how "why was
+        THIS round slow" reads without collecting per-peer /trace dumps."""
+        records = records if records is not None else fetch_swarm_telemetry(self.dht, self.key)
+        by_trace: Dict[str, list] = {}
+        for peer, snapshot in records.items():
+            for span in snapshot.get("recent_spans") or ():
+                if isinstance(span, dict) and span.get("trace"):
+                    by_trace.setdefault(span["trace"], []).append((peer, span))
+        lines = [f"swarm timeline: {len(by_trace)} traces from {len(records)} peers"]
+        # most recently started traces first; spans within a trace in time order
+        def trace_start(spans):
+            return min(float(s.get("start", 0.0)) for _p, s in spans)
+
+        for trace_id, spans in sorted(by_trace.items(), key=lambda kv: -trace_start(kv[1])):
+            spans.sort(key=lambda item: float(item[1].get("start", 0.0)))
+            origin = float(spans[0][1].get("start", 0.0))
+            lines.append(f"trace {trace_id}:")
+            for peer, span in spans:
+                offset_ms = (float(span.get("start", 0.0)) - origin) * 1e3
+                events = f" !{','.join(span['events'])}" if span.get("events") else ""
+                lines.append(
+                    f"  +{offset_ms:8.1f}ms {peer[:12]:<12} {span.get('name')}"
+                    f" ({span.get('dur_ms')}ms){events}"
+                )
         return "\n".join(lines)

@@ -1,0 +1,381 @@
+"""Distributed tracing (ISSUE 4 tentpole): cross-peer spans, an always-on
+flight recorder, and Chrome-trace/Perfetto export.
+
+PR 2's metrics answer "how much / how often"; this module answers *why was this
+round slow, and which peer stalled it*. The pieces:
+
+- :class:`Span` — one timed operation: ``trace_id``/``span_id``/``parent_id``
+  (64-bit), monotonic start/end, attributes, and a list of timestamped events
+  (chaos injections, breaker trips, retries land here — see
+  ``resilience/chaos.py``, ``resilience/breaker.py``, ``resilience/policy.py``).
+- :func:`trace` — contextvar-scoped span context manager; :func:`current_span`
+  reads the active one. Works across ``await`` (tasks inherit contextvars).
+- :class:`SpanRecorder` — the flight recorder: a bounded per-process ring
+  buffer of *finished* spans. Always on, fixed memory, oldest-evicted. Spans
+  whose duration crosses :func:`set_slow_span_threshold` are additionally kept
+  in a small side ring and logged with their event chain.
+- :func:`render_chrome_trace` — Chrome trace-event JSON (loads directly in
+  Perfetto / ``chrome://tracing``). Each distinct ``peer`` attribute becomes
+  one pid row, so multi-peer-in-one-process tests and real swarm dumps both
+  read as one row per peer. Served at ``GET /trace`` by
+  :class:`~hivemind_tpu.telemetry.exporter.MetricsExporter`.
+
+Cross-peer propagation: ``p2p/p2p.py`` piggybacks the active span's
+``(trace_id, span_id)`` on the mux OPEN frame (16 bytes, only when a span is
+active), so a server-side handler span becomes a child of the remote caller's
+span; :func:`pack_context` / :func:`unpack_context` define the wire form.
+
+Cost discipline (acceptance criterion): with tracing disabled
+(``HIVEMIND_TRACE=0``) an instrumented site costs one module-bool check and
+one contextvar read; with it enabled (the default) a span is one small object
+plus a ring-buffer append at exit — no serialization happens anywhere off the
+export path.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import random
+import struct
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from hivemind_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_CTX_STRUCT = struct.Struct(">QQ")  # (trace_id, span_id) — the wire context
+
+# wall-clock anchor: spans are timed with perf_counter (monotonic, immune to
+# NTP steps); export adds this offset so timelines from different peers align
+# on the wall clock as well as their clocks themselves agree
+_WALL_ANCHOR = time.time() - time.perf_counter()
+
+_current_span: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
+    "hivemind_current_span", default=None
+)
+
+# one rng for id generation; seeded from the OS so forked peers diverge.
+# random.Random methods are atomic under the GIL — no lock needed.
+_ids = random.Random(int.from_bytes(os.urandom(8), "big") ^ os.getpid())
+
+enabled = os.environ.get("HIVEMIND_TRACE", "1") != "0"
+
+
+def _new_id() -> int:
+    return _ids.getrandbits(64) or 1  # 0 is reserved for "no id"
+
+
+class Span:
+    """One timed operation. Created via :func:`trace` / :func:`start_span`;
+    finished spans land in the flight recorder."""
+
+    __slots__ = (
+        "name", "trace_id", "span_id", "parent_id", "start", "end",
+        "attributes", "events", "thread_id",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        trace_id: Optional[int] = None,
+        parent_id: Optional[int] = None,
+        attributes: Optional[Dict[str, Any]] = None,
+    ):
+        self.name = name
+        self.trace_id = trace_id if trace_id else _new_id()
+        self.span_id = _new_id()
+        self.parent_id = parent_id
+        self.start = time.perf_counter()
+        self.end: Optional[float] = None
+        self.attributes = attributes
+        self.events: Optional[List[Tuple[float, str, Optional[Dict[str, Any]]]]] = None
+        self.thread_id = threading.get_ident()
+
+    # ------------------------------------------------------------------ recording
+
+    def set(self, key: str, value: Any) -> None:
+        if self.attributes is None:
+            self.attributes = {}
+        self.attributes[key] = value
+
+    def add_event(self, name: str, **attributes: Any) -> None:
+        """Record a timestamped event on this span (chaos injection, breaker
+        trip, retry attempt, ...). Cheap: one tuple append."""
+        if self.events is None:
+            self.events = []
+        self.events.append((time.perf_counter(), name, attributes or None))
+
+    @property
+    def duration(self) -> float:
+        return (self.end if self.end is not None else time.perf_counter()) - self.start
+
+    def context_bytes(self) -> bytes:
+        """The 16-byte wire context piggybacked on RPC envelopes."""
+        return _CTX_STRUCT.pack(self.trace_id, self.span_id)
+
+    # ------------------------------------------------------------------ export
+
+    def summary(self) -> Dict[str, Any]:
+        """Compact JSON-able view (DHT peer snapshots, monitor timelines)."""
+        out: Dict[str, Any] = {
+            "name": self.name,
+            "trace": f"{self.trace_id:016x}",
+            "span": f"{self.span_id:016x}",
+            "start": round(self.start + _WALL_ANCHOR, 6),
+            "dur_ms": round(self.duration * 1e3, 3),
+        }
+        if self.parent_id:
+            out["parent"] = f"{self.parent_id:016x}"
+        if self.attributes:
+            out.update({k: v for k, v in self.attributes.items() if isinstance(v, (str, int, float, bool))})
+        if self.events:
+            out["events"] = [name for _t, name, _a in self.events]
+        return out
+
+    def __repr__(self) -> str:
+        state = f"{self.duration * 1e3:.2f}ms" if self.end is not None else "open"
+        return f"Span({self.name!r}, trace={self.trace_id:016x}, {state})"
+
+
+def pack_context(span: Optional[Span]) -> Optional[bytes]:
+    """Wire context of a span (None when there is nothing to propagate)."""
+    return None if span is None else span.context_bytes()
+
+
+def unpack_context(raw: Optional[bytes]) -> Optional[Tuple[int, int]]:
+    """Parse a remote peer's 16-byte context; None when absent or malformed
+    (a peer must not be able to crash a handler with a bad envelope)."""
+    if raw is None or len(raw) != _CTX_STRUCT.size:
+        return None
+    try:
+        trace_id, span_id = _CTX_STRUCT.unpack(raw)
+    except struct.error:  # pragma: no cover - length is checked above
+        return None
+    return (trace_id, span_id) if trace_id and span_id else None
+
+
+# ---------------------------------------------------------------------- recorder
+
+
+class SpanRecorder:
+    """The flight recorder: a fixed-capacity ring of finished spans. Appends
+    are one deque op (GIL-atomic); the oldest span is evicted at capacity, so
+    memory is bounded no matter how long the process runs."""
+
+    def __init__(self, capacity: int = 4096, slow_capacity: int = 32):
+        self._ring: "deque[Span]" = deque(maxlen=capacity)
+        self._slow: "deque[Span]" = deque(maxlen=slow_capacity)
+        self.slow_threshold = float(os.environ.get("HIVEMIND_SLOW_SPAN_S", "10.0"))
+        self.dropped = 0  # spans evicted so far (diagnosing undersized rings)
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen or 0
+
+    def record(self, span: Span) -> None:
+        ring = self._ring
+        if len(ring) == ring.maxlen:
+            self.dropped += 1
+        ring.append(span)
+        if span.end is not None and span.end - span.start >= self.slow_threshold:
+            self._slow.append(span)
+            chain = [name for _t, name, _a in span.events] if span.events else []
+            logger.warning(
+                f"slow span {span.name!r}: {span.duration:.3f}s "
+                f"(threshold {self.slow_threshold}s), events={chain}, "
+                f"trace={span.trace_id:016x}"
+            )
+
+    def snapshot(self) -> List[Span]:
+        return list(self._ring)
+
+    def slow_spans(self) -> List[Span]:
+        return list(self._slow)
+
+    def summaries(self, limit: int = 30) -> List[Dict[str, Any]]:
+        """The most recent ``limit`` finished spans, compact (peer snapshots)."""
+        ring = self._ring
+        spans = list(ring)[-limit:] if limit else list(ring)
+        return [span.summary() for span in spans]
+
+    def clear(self) -> None:
+        self._ring.clear()
+        self._slow.clear()
+        self.dropped = 0
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+
+RECORDER = SpanRecorder()
+
+
+def set_slow_span_threshold(seconds: float) -> None:
+    """Spans at least this long are kept in the slow ring and logged with
+    their event chain (the "why was this round slow" log line)."""
+    RECORDER.slow_threshold = float(seconds)
+
+
+# ---------------------------------------------------------------------- creation
+
+
+def current_span() -> Optional[Span]:
+    """The span active in this task/thread, or None."""
+    return _current_span.get()
+
+
+def install_span(span: Optional[Span]):
+    """Make ``span`` current WITHOUT a context manager (returns the reset token
+    for :func:`uninstall_span`). For operations whose span outlives the block
+    that created it — e.g. futures-mode DHT gets, where the span is finished
+    from a done-callback after the creating coroutine returned."""
+    return _current_span.set(span)
+
+
+def uninstall_span(token) -> None:
+    _current_span.reset(token)
+
+
+def start_span(
+    name: str,
+    parent: Optional[Span] = None,
+    remote_context: Optional[Tuple[int, int]] = None,
+    **attributes: Any,
+) -> Optional[Span]:
+    """Create a span WITHOUT installing it as current (for code that cannot
+    hold a context manager open, e.g. async generators — a generator's body
+    runs in its consumer's context, so installing would leak). Finish with
+    :func:`finish_span`. Returns None when tracing is disabled."""
+    if not enabled:
+        return None
+    if parent is None and remote_context is None:
+        parent = _current_span.get()
+    if remote_context is not None:
+        trace_id, parent_id = remote_context
+    else:
+        trace_id = parent.trace_id if parent is not None else None
+        parent_id = parent.span_id if parent is not None else None
+    return Span(name, trace_id=trace_id, parent_id=parent_id, attributes=attributes or None)
+
+
+def finish_span(span: Optional[Span], recorder: Optional[SpanRecorder] = None) -> None:
+    """Stamp the end time and append to the flight recorder. None-safe so call
+    sites need no enabled-check of their own."""
+    if span is None:
+        return
+    span.end = time.perf_counter()
+    (recorder if recorder is not None else RECORDER).record(span)
+
+
+class trace:
+    """``with trace("dht.store", peer=...) as span:`` — create a child of the
+    current span, install it for the block, record it at exit. The standard
+    way to instrument a code path; use :func:`start_span` only where a context
+    manager cannot wrap the operation."""
+
+    __slots__ = ("_name", "_attributes", "_remote", "_parent", "span", "_token")
+
+    def __init__(
+        self,
+        name: str,
+        remote_context: Optional[Tuple[int, int]] = None,
+        parent: Optional[Span] = None,
+        **attributes: Any,
+    ):
+        self._name = name
+        self._attributes = attributes
+        self._remote = remote_context
+        self._parent = parent
+        self.span: Optional[Span] = None
+        self._token = None
+
+    def __enter__(self) -> Optional[Span]:
+        if not enabled:
+            return None
+        self.span = start_span(
+            self._name, parent=self._parent, remote_context=self._remote, **self._attributes
+        )
+        self._token = _current_span.set(self.span)
+        return self.span
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._token is not None:
+            _current_span.reset(self._token)
+            self._token = None
+        if self.span is not None:
+            if exc_type is not None:
+                self.span.add_event("error", type=exc_type.__name__)
+            finish_span(self.span)
+        return False
+
+
+# ---------------------------------------------------------------------- export
+
+
+def render_chrome_trace(
+    spans: Optional[Iterable[Span]] = None, default_peer: str = "local"
+) -> Dict[str, Any]:
+    """Spans as a Chrome trace-event JSON object (the ``{"traceEvents": [...]}``
+    form; opens directly in Perfetto / ``chrome://tracing``).
+
+    pid/tid mapping: each distinct ``peer`` span attribute becomes one pid row
+    (named via ``process_name`` metadata); tids are the recording threads. Span
+    events render as instant events on the same row, and every event carries
+    its trace/span/parent ids in ``args`` so traces remain greppable."""
+    spans = RECORDER.snapshot() if spans is None else list(spans)
+    peers: Dict[str, int] = {}
+    events: List[Dict[str, Any]] = []
+    for span in spans:
+        peer = default_peer
+        if span.attributes is not None:
+            peer = str(span.attributes.get("peer", default_peer))
+        pid = peers.get(peer)
+        if pid is None:
+            pid = peers[peer] = len(peers) + 1
+        ts_us = (span.start + _WALL_ANCHOR) * 1e6
+        dur_us = max(span.duration * 1e6, 0.001)
+        args: Dict[str, Any] = {
+            "trace_id": f"{span.trace_id:016x}",
+            "span_id": f"{span.span_id:016x}",
+        }
+        if span.parent_id:
+            args["parent_id"] = f"{span.parent_id:016x}"
+        if span.attributes:
+            args.update(
+                {k: v for k, v in span.attributes.items() if isinstance(v, (str, int, float, bool))}
+            )
+        events.append(
+            {
+                "name": span.name, "cat": "span", "ph": "X",
+                "ts": round(ts_us, 3), "dur": round(dur_us, 3),
+                "pid": pid, "tid": span.thread_id % 2**31, "args": args,
+            }
+        )
+        for when, event_name, event_attrs in span.events or ():
+            instant_args = {"span_id": f"{span.span_id:016x}"}
+            if event_attrs:
+                instant_args.update(event_attrs)
+            events.append(
+                {
+                    "name": event_name, "cat": "event", "ph": "i", "s": "t",
+                    "ts": round((when + _WALL_ANCHOR) * 1e6, 3),
+                    "pid": pid, "tid": span.thread_id % 2**31, "args": instant_args,
+                }
+            )
+    for peer, pid in peers.items():
+        events.append(
+            {
+                "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"name": f"peer {peer}"},
+            }
+        )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def render_chrome_trace_json(spans: Optional[Iterable[Span]] = None) -> str:
+    return json.dumps(render_chrome_trace(spans), default=str)

@@ -27,13 +27,19 @@ from hivemind_tpu.utils.performance_ema import PerformanceEMA
 
 
 @contextlib.contextmanager
-def trace_span(name: str):
-    """Label a host-side region on the XLA profiler timeline (no-op overhead when
-    no trace is being captured)."""
+def trace_span(name: str, **attributes):
+    """Label a host-side region on BOTH timelines under one name: the XLA
+    profiler trace (device view — HBM traffic, fusions, per-op device time) and
+    the swarm telemetry tracer (host view — the flight recorder, ``/trace``
+    Perfetto export, cross-peer parenting). One call site, two synchronized
+    views; the shared name is what lets you line them up in Perfetto."""
     import jax
 
-    with jax.profiler.TraceAnnotation(name):
-        yield
+    from hivemind_tpu.telemetry.tracing import trace as _telemetry_trace
+
+    with _telemetry_trace(name, **attributes):
+        with jax.profiler.TraceAnnotation(name):
+            yield
 
 
 @contextlib.contextmanager

@@ -43,11 +43,16 @@ def pytest_pyfunc_call(pyfuncitem):
 def cleanup_children():
     """Reset process-wide singletons between tests (reference tests/conftest.py:14-33)."""
     yield
+    import os
+
     from hivemind_tpu.resilience import CHAOS, reset_all_boards
+    from hivemind_tpu.telemetry.tracing import RECORDER
     from hivemind_tpu.utils.crypto import Ed25519PrivateKey
 
     CHAOS.clear()  # a test's armed fault rules must never leak into the next test
     reset_all_boards()  # module-level breaker boards (e.g. moe EXPERT_BREAKERS) too
+    RECORDER.clear()  # one test's spans must not satisfy another's assertions
+    RECORDER.slow_threshold = float(os.environ.get("HIVEMIND_SLOW_SPAN_S", "10.0"))
     Ed25519PrivateKey.reset_process_wide()
     gc.collect()
 

@@ -8,6 +8,7 @@ import time
 
 import numpy as np
 import optax
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -43,6 +44,9 @@ def _make_opt(dht, **overrides):
     return Optimizer(**options)
 
 
+@pytest.mark.slow  # ~80 s; the sub-minute churn equivalents are
+# test_slice_optimizer.py::test_slice_degrades_to_local_grads_and_recovers_on_groupmate_churn
+# and test_slice_optimizer.py::test_slice_state_download_fails_over_when_donor_dies_mid_stream
 def test_join_catch_up_and_peer_death():
     features, targets, loss_and_grad = _toy_problem()
     dhts = launch_dht_swarm(3)

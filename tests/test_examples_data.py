@@ -100,6 +100,8 @@ def test_run_trainer_causal_model_smoke():
     assert re.search(r"training finished after 6 steps at epoch (\d+)", run.stderr), run.stderr[-2000:]
 
 
+@pytest.mark.slow  # ~50 s; the one-process trainer path stays covered by
+# test_run_trainer_causal_model_smoke, and two-peer swarm training by test_optimizer.py
 def test_run_trainer_two_peer_smoke():
     """The flagship recipe end-to-end: two run_trainer.py processes (tiny config,
     synthetic data) form a swarm, advance epochs together, and exit cleanly after

@@ -127,6 +127,9 @@ def test_training_averager_legacy():
             dht.shutdown()
 
 
+@pytest.mark.slow  # ~30 s; PowerSGD averaging is covered in ~1 s by
+# test_powersgd_two_peer_average above, and the optimizer integration by
+# test_optimizer_dpu.py::test_powersgd_with_dpu_convergence
 def test_optimizer_with_powersgd_factory():
     """The collaborative Optimizer with PowerSGD gradient compression (the albert
     recipe's --powersgd_rank path): two peers converge through low-rank averaged

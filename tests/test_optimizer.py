@@ -184,6 +184,8 @@ def test_optimizer_collaborative_convergence():
             dht.shutdown()
 
 
+@pytest.mark.slow  # ~60 s (three-peer convergence); client-mode averaging
+# semantics stay covered in ~1 s by test_averaging.py::test_averaging_client_mode
 def test_optimizer_client_mode_peer_contributes():
     """A client_mode peer (firewalled: sends gradients, never reduces) trains
     alongside two full peers; all three stay epoch-synced and converge, and the

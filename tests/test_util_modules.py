@@ -227,6 +227,8 @@ def test_process_wide_key_singleton():
     assert k1 is k2
 
 
+@pytest.mark.slow  # ~24 s (profile_to captures a real XLA trace); trace_span's
+# telemetry half is covered sub-second by test_tracing.py::test_unified_trace_span
 def test_profiling_hooks():
     """trace_span/profile_to/StepProfiler: XLA profiler integration + throughput EMA."""
     import tempfile
