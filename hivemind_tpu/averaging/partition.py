@@ -1,16 +1,27 @@
 """Tensor partitioning for butterfly all-reduce (capability parity: reference
 hivemind/averaging/partition.py).
 
-``TensorPartContainer`` flattens a tensor list into one logical stream, slices it
+``TensorPartContainer`` exposes a tensor list as one logical fp32 stream, slices it
 into per-peer spans (element counts from the load balancer) and further into parts of
 at most ``part_size_bytes``; compression runs in the shared executor with bounded
 prefetch. ``TensorPartReducer`` accumulates incoming parts for the span this peer
-reduces, with weighted averaging and denominator shrinking when senders fail."""
+reduces, with weighted averaging and denominator shrinking when senders fail.
+
+Throughput notes (ISSUE 6): the container never materializes the concatenated
+stream — it keeps per-tensor fp32 views (``astype(copy=False)``: zero-copy when the
+input is already fp32) plus an offset index, so only the rare part that straddles a
+tensor boundary is assembled with a copy. Parts that live in container-private
+memory (dtype-conversion copies or boundary assemblies) are compressed with
+``allow_inplace=True``. The reducer accumulates with ``np.add(..., out=...)`` into
+the accumulator, stages weighted parts in one reusable scratch buffer, and divides
+in place — no per-part temporaries. All replaced ops are bit-identical to the
+naive forms (same fp32 instructions in the same order)."""
 
 from __future__ import annotations
 
 import asyncio
-from typing import AsyncIterator, Dict, List, Optional, Sequence, Tuple
+import bisect
+from typing import AsyncIterator, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -22,7 +33,12 @@ from hivemind_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
-DEFAULT_PART_SIZE_BYTES = 2**19  # 512 KiB pre-compression (reference partition.py:17)
+# pre-compression part size. The reference default is 512 KiB (partition.py:17);
+# 2 MiB measures ~35% faster end-to-end on the loopback averaging benchmark (fewer
+# per-part serialize/frame/seal round trips for the same bytes — benchmarks/RESULTS.md
+# ISSUE 6) and still fits the mux message cap with fp32 headroom after compression.
+# Part boundaries do not affect numerics: per-element accumulation order is the same.
+DEFAULT_PART_SIZE_BYTES = 2**21
 
 
 def compute_span_part_sizes(element_count: int, part_size_bytes: int) -> List[int]:
@@ -45,8 +61,10 @@ class AllreduceException(RuntimeError):
 class TensorPartContainer:
     """Splits tensors into per-peer parts and reassembles processed deltas.
 
-    :param tensors: the local tensors (numpy or jax; flattened copy is taken in fp32)
+    :param tensors: the local tensors (numpy or jax; viewed as fp32 without copying
+        when possible)
     :param peer_element_counts: elements assigned to each peer (sums to total numel)
+    :param prefetch: how many parts may be serialized ahead of the network consumer
     """
 
     def __init__(
@@ -58,16 +76,32 @@ class TensorPartContainer:
         tensor_infos: Optional[Sequence[CompressionInfo]] = None,
         prefetch: int = 4,
     ):
+        assert prefetch > 0, "prefetch must be positive"
         self.tensors = [as_numpy(t) for t in tensors]
         self.peer_element_counts = tuple(peer_element_counts)
         self.compression = compression
         self.part_size_elements = max(1, part_size_bytes // 4)  # parts travel as fp32
         self.tensor_infos = tensor_infos
+        self.prefetch = prefetch
         total = sum(int(np.prod(t.shape)) for t in self.tensors)
         assert sum(peer_element_counts) == total, (sum(peer_element_counts), total)
         self.total_elements = total
 
-        self._flat = np.concatenate([t.reshape(-1).astype(np.float32) for t in self.tensors]) if total else np.zeros(0, np.float32)
+        # per-tensor fp32 flat views over the logical stream (no global concat);
+        # a flat is "private" when conversion already forced a copy, which makes
+        # in-place compression of its parts safe (the caller's memory is untouched
+        # and every element belongs to exactly one part, read exactly once)
+        self._tensor_flats: List[np.ndarray] = []
+        self._flat_private: List[bool] = []
+        self._tensor_offsets: List[int] = []  # start offset of each tensor in the stream
+        offset = 0
+        for tensor in self.tensors:
+            flat32 = tensor.reshape(-1).astype(np.float32, copy=False)
+            self._tensor_flats.append(flat32)
+            self._flat_private.append(not np.may_share_memory(flat32, tensor))
+            self._tensor_offsets.append(offset)
+            offset += flat32.size
+
         # per-peer list of (start, stop) part spans in the flat stream
         self.parts_by_peer: List[List[Tuple[int, int]]] = []
         offset = 0
@@ -79,24 +113,54 @@ class TensorPartContainer:
             self.parts_by_peer.append(spans)
         self.num_parts_by_peer = tuple(len(spans) for spans in self.parts_by_peer)
 
-        self._delta = np.zeros_like(self._flat)
+        # deltas accumulate per tensor (same total footprint as one flat buffer)
+        self._tensor_deltas = [np.zeros(flat.size, np.float32) for flat in self._tensor_flats]
         self._part_ready: Dict[Tuple[int, int], asyncio.Event] = {}
         self._peer_failed = [False] * len(self.peer_element_counts)
         self.failed_size = 0
         self._finished = asyncio.Event()
 
+    def _stream_slices(self, start: int, stop: int) -> Iterator[Tuple[int, int, int]]:
+        """Yield (tensor_index, local_start, local_stop) covering stream range
+        [start, stop) in order; zero-size tensors are skipped."""
+        index = bisect.bisect_right(self._tensor_offsets, start) - 1
+        while start < stop:
+            tensor_start = self._tensor_offsets[index]
+            tensor_stop = tensor_start + self._tensor_flats[index].size
+            if tensor_stop <= start:
+                index += 1
+                continue
+            take = min(stop, tensor_stop)
+            yield index, start - tensor_start, take - tensor_start
+            start = take
+            index += 1
+
+    def _input_part(self, start: int, stop: int) -> Tuple[np.ndarray, bool]:
+        """One part of the logical stream and whether its memory is container-private
+        (safe for in-place compression). The common case — a part inside one tensor —
+        is a zero-copy view; only boundary-straddling parts are assembled."""
+        pieces = [
+            (index, self._tensor_flats[index][local_start:local_stop])
+            for index, local_start, local_stop in self._stream_slices(start, stop)
+        ]
+        if len(pieces) == 1:
+            index, view = pieces[0]
+            return view, self._flat_private[index]
+        return np.concatenate([view for _index, view in pieces]), True
+
     def get_raw_input_parts(self, peer_index: int) -> List[np.ndarray]:
-        return [self._flat[start:stop] for start, stop in self.parts_by_peer[peer_index]]
+        return [self._input_part(start, stop)[0] for start, stop in self.parts_by_peer[peer_index]]
 
     async def iterate_input_parts_for(self, peer_index: int) -> AsyncIterator[runtime_pb2.Tensor]:
         """Serialized parts destined for one peer; compression happens in the shared
-        thread pool with prefetch (reference partition.py:104-112)."""
-        parts = self.get_raw_input_parts(peer_index)
+        thread pool with bounded prefetch (reference partition.py:104-112)."""
+        parts = [self._input_part(start, stop) for start, stop in self.parts_by_peer[peer_index]]
 
-        def _compress(part: np.ndarray) -> runtime_pb2.Tensor:
-            return serialize_tensor(part, self.compression)
+        def _compress(item: Tuple[np.ndarray, bool]) -> runtime_pb2.Tensor:
+            part, private = item
+            return serialize_tensor(part, self.compression, allow_inplace=private)
 
-        async for serialized in amap_in_executor(_compress, as_aiter(*parts), max_prefetch=4):
+        async for serialized in amap_in_executor(_compress, as_aiter(*parts), max_prefetch=self.prefetch):
             yield serialized
 
     def register_processed_part(self, peer_index: int, part_index: int, delta_part: np.ndarray) -> None:
@@ -107,7 +171,12 @@ class TensorPartContainer:
             raise AllreduceException(
                 f"part size mismatch from peer {peer_index}: got {delta_part.size}, expected {expected}"
             )
-        self._delta[start:stop] = delta_part.reshape(-1)
+        flat_delta = delta_part.reshape(-1)
+        consumed = 0
+        for index, local_start, local_stop in self._stream_slices(start, stop):
+            length = local_stop - local_start
+            self._tensor_deltas[index][local_start:local_stop] = flat_delta[consumed : consumed + length]
+            consumed += length
         self._mark_ready(peer_index, part_index)
 
     def register_failed_reducer(self, peer_index: int) -> None:
@@ -145,14 +214,14 @@ class TensorPartContainer:
         ordered_parts.sort(key=lambda item: item[2])
         cursor = 0  # next ordered part not yet awaited
         offset = 0
-        for tensor in self.tensors:
+        for tensor_index, tensor in enumerate(self.tensors):
             numel = int(np.prod(tensor.shape))
             tensor_end = offset + numel
             while cursor < len(ordered_parts) and ordered_parts[cursor][2] < tensor_end:
                 peer_index, part_index, _start, _stop = ordered_parts[cursor]
                 await self._wait_part(peer_index, part_index)
                 cursor += 1
-            yield self._delta[offset:tensor_end].reshape(tensor.shape)
+            yield self._tensor_deltas[tensor_index].reshape(tensor.shape)
             offset = tensor_end
         self._finished.set()
 
@@ -174,6 +243,7 @@ class TensorPartReducer:
         # per-part: accumulator, total weight, contributed sender flags, done future
         self._parts: Dict[int, dict] = {}
         self._closed = False
+        self._scratch: Optional[np.ndarray] = None  # reusable weighted-part staging
 
     def _part_state(self, part_index: int) -> dict:
         if part_index not in self._parts:
@@ -201,11 +271,22 @@ class TensorPartReducer:
         state = self._part_state(part_index)
         if state["contributed"][sender_index]:
             raise AllreduceException(f"sender {sender_index} sent part {part_index} twice")
-        part32 = part.reshape(state["accumulator"].shape).astype(np.float32)
-        state["accumulator"] += part32 * weight
-        state["total_weight"] += weight
         state["contributed"][sender_index] = True
-        self._maybe_finish(part_index)
+        if not state["future"].done():
+            # the accumulator IS the eventual result (divided in place), so a
+            # laggard whose part arrives after resolution must not touch it
+            accumulator = state["accumulator"]
+            part32 = part.reshape(accumulator.shape).astype(np.float32, copy=False)
+            if weight == 1.0:
+                np.add(accumulator, part32, out=accumulator)
+            else:
+                if self._scratch is None or self._scratch.size < accumulator.size:
+                    self._scratch = np.empty(max(int(np.prod(shape)) for shape in self.part_shapes), np.float32)
+                scratch = self._scratch[: accumulator.size].reshape(accumulator.shape)
+                np.multiply(part32, weight, out=scratch)
+                np.add(accumulator, scratch, out=accumulator)
+            state["total_weight"] += weight
+            self._maybe_finish(part_index)
         return await asyncio.shield(state["future"])
 
     def on_sender_failed(self, sender_index: int) -> None:
@@ -240,7 +321,9 @@ class TensorPartReducer:
         if state["total_weight"] <= 0:
             state["future"].set_exception(AllreduceException(f"part {part_index}: no live contributions"))
             return
-        state["future"].set_result(state["accumulator"] / state["total_weight"])
+        averaged = state["accumulator"]
+        np.divide(averaged, state["total_weight"], out=averaged)
+        state["future"].set_result(averaged)
 
     # -------------------------------------------------------------- public queries
     # (the allreduce stream handler and laggard watchdog must observe reduction

@@ -25,9 +25,16 @@ class Float16Compression(CompressionBase):
     def compress(self, array: Any, info: Optional[CompressionInfo] = None, allow_inplace: bool = False) -> runtime_pb2.Tensor:
         array = as_numpy(array)
         original_dtype = "bfloat16" if str(array.dtype) == "bfloat16" else array.dtype.name
-        clipped = np.clip(array.astype(np.float32), -FP16_MAX, FP16_MAX).astype(np.float16)
+        array32 = array.astype(np.float32, copy=False)
+        # a dtype conversion already made array32 private; otherwise in-place needs
+        # the caller's explicit permission (bit-identical either way — same values)
+        private = True if array32 is not array else allow_inplace
+        if private and array32.flags.writeable:
+            clipped32 = np.clip(array32, -FP16_MAX, FP16_MAX, out=array32)
+        else:
+            clipped32 = np.clip(array32, -FP16_MAX, FP16_MAX)
         return runtime_pb2.Tensor(
-            buffer=clipped.tobytes(),
+            buffer=clipped32.astype(np.float16).tobytes(),
             size=array.shape,
             dtype=original_dtype,
             compression=self.compression_type,
@@ -52,7 +59,7 @@ class ScaledFloat16Compression(Float16Compression):
     def compress(self, array: Any, info: Optional[CompressionInfo] = None, allow_inplace: bool = False) -> runtime_pb2.Tensor:
         array = as_numpy(array)
         original_dtype = "bfloat16" if str(array.dtype) == "bfloat16" else array.dtype.name
-        array32 = array.astype(np.float32)
+        array32 = array.astype(np.float32, copy=False)
         if array32.ndim == 0:
             array32 = array32.reshape(1)
             means = np.zeros(1, np.float32)
@@ -61,7 +68,13 @@ class ScaledFloat16Compression(Float16Compression):
         else:
             means = array32.mean(axis=-1, keepdims=True, dtype=np.float32)
             stds = array32.std(axis=-1, keepdims=True, dtype=np.float32) + 1e-6
-            normalized = (array32 - means) / stds
+            private = True if array32 is not array else allow_inplace
+            if private and array32.flags.writeable:
+                np.subtract(array32, means, out=array32)
+                np.divide(array32, stds, out=array32)
+                normalized = array32
+            else:
+                normalized = (array32 - means) / stds
         half = np.clip(normalized, -FP16_MAX, FP16_MAX).astype(np.float16)
         buffer = half.tobytes() + means.astype(np.float32).tobytes() + stds.astype(np.float32).tobytes()
         return runtime_pb2.Tensor(

@@ -59,6 +59,18 @@ def deserialize_to_jax(serialized: runtime_pb2.Tensor):
     return jnp.asarray(deserialize_tensor(serialized))
 
 
+def _clone_tensor_metadata(source: runtime_pb2.Tensor) -> runtime_pb2.Tensor:
+    """A Tensor message carrying every field of ``source`` EXCEPT its (possibly
+    multi-MiB) payload — chunking helpers must never duplicate the buffer just to
+    replace it (ISSUE 6 satellite: the old CopyFrom+overwrite did exactly that)."""
+    return runtime_pb2.Tensor(
+        size=source.size,
+        dtype=source.dtype,
+        requires_grad=source.requires_grad,
+        compression=source.compression,
+    )
+
+
 async def deserialize_tensor_stream(stream: AsyncIterator[List[runtime_pb2.Tensor]]) -> List[np.ndarray]:
     """Reassemble tensors from a stream of chunked parts: each tensor arrives as its
     first message (with ``chunks`` = total count) followed by buffer-only continuation
@@ -70,10 +82,8 @@ async def deserialize_tensor_stream(stream: AsyncIterator[List[runtime_pb2.Tenso
             parts.append(chunk)
             total = parts[0].chunks or 1
             if len(parts) == total:
-                combined = runtime_pb2.Tensor()
-                combined.CopyFrom(parts[0])
+                combined = _clone_tensor_metadata(parts[0])
                 combined.buffer = b"".join(p.buffer for p in parts)
-                combined.chunks = 0
                 tensors.append(deserialize_tensor(combined))
                 parts = []
     if parts:
@@ -87,8 +97,7 @@ def split_tensor_for_streaming(serialized: runtime_pb2.Tensor, chunk_size_bytes:
     from hivemind_tpu.utils.streaming import split_for_streaming
 
     buffers = list(split_for_streaming(serialized.buffer, chunk_size_bytes))
-    first = runtime_pb2.Tensor()
-    first.CopyFrom(serialized)
+    first = _clone_tensor_metadata(serialized)
     first.buffer = buffers[0]
     first.chunks = len(buffers)
     out = [first]

@@ -125,13 +125,18 @@ class SecureChannel:
 
     # ------------------------------------------------------------------ send side
 
-    async def send(self, payload: bytes) -> None:
+    async def send(self, payload: bytes, *extra_buffers: bytes) -> None:
+        """Seal and send one frame. The plaintext is the concatenation of all given
+        buffers — scatter-gather: callers framing a header in front of a large
+        payload pass both instead of concatenating (the AEAD walks the pieces;
+        only the ciphertext output is a fresh buffer)."""
         if self._send_error is not None:
             raise self._send_failed()
+        total_len = len(payload) + sum(len(buffer) for buffer in extra_buffers)
         # size check BEFORE the counter moves: raising after an increment would
         # desynchronize AEAD nonces and poison the whole connection
-        if len(payload) + 16 > MAX_FRAME_SIZE:  # +16: poly1305 tag
-            raise ValueError(f"frame too large: {len(payload)} > {MAX_FRAME_SIZE - 16}")
+        if total_len + 16 > MAX_FRAME_SIZE:  # +16: poly1305 tag
+            raise ValueError(f"frame too large: {total_len} > {MAX_FRAME_SIZE - 16}")
         await self._send_sem.acquire()
         if self._send_error is not None:
             self._send_sem.release()
@@ -141,15 +146,25 @@ class SecureChannel:
         nonce = struct.pack("<4xQ", self._send_counter)
         self._send_counter += 1
         executor = _get_aead_executor()
-        if executor is not None and len(payload) >= _OFFLOAD_THRESHOLD:
+        if executor is not None and total_len >= _OFFLOAD_THRESHOLD:
             sealed = asyncio.get_running_loop().run_in_executor(
-                executor, self._send_aead.encrypt, nonce, payload, None
+                executor, self._seal, nonce, payload, extra_buffers
             )
         else:
-            sealed = self._send_aead.encrypt(nonce, payload, None)
+            sealed = self._seal(nonce, payload, extra_buffers)
         if self._writer_task is None:
             self._writer_task = asyncio.create_task(self._writer_loop())
         self._send_queue.put_nowait(sealed)
+
+    def _seal(self, nonce: bytes, payload: bytes, extra_buffers: Tuple[bytes, ...]) -> bytes:
+        if not extra_buffers:
+            return self._send_aead.encrypt(nonce, payload, None)
+        encrypt_parts = getattr(self._send_aead, "encrypt_parts", None)
+        if encrypt_parts is not None:
+            return encrypt_parts(nonce, (payload, *extra_buffers), None)
+        # cipher without multi-buffer support: one join is still cheaper than
+        # making every caller concatenate ahead of the size check
+        return self._send_aead.encrypt(nonce, b"".join((payload, *extra_buffers)), None)
 
     def _send_failed(self) -> ConnectionError:
         error = self._send_error
@@ -340,6 +355,10 @@ class _NullAEAD:
     @staticmethod
     def encrypt(nonce: bytes, data: bytes, aad) -> bytes:
         return data
+
+    @staticmethod
+    def encrypt_parts(nonce: bytes, parts, aad) -> bytes:
+        return b"".join(parts)
 
     @staticmethod
     def decrypt(nonce: bytes, data: bytes, aad) -> bytes:

@@ -25,7 +25,11 @@ import asyncio
 import struct
 import time
 from enum import IntFlag
-from typing import AsyncIterator, Awaitable, Callable, Dict, Optional
+from typing import AsyncIterator, Awaitable, Callable, Dict, Optional, Union
+
+# what receive() yields: bytes for locally-generated items, a zero-copy memoryview
+# of the decrypted wire frame for DATA payloads
+Message = Union[bytes, memoryview]
 
 from hivemind_tpu.p2p.crypto_channel import SecureChannel
 from hivemind_tpu.telemetry.tracing import unpack_context
@@ -124,13 +128,14 @@ class MuxStream:
             self._push_eof()
             self._conn._forget_stream(self.stream_id)
 
-    async def receive(self) -> bytes:
-        """Next message; raises StreamClosedError at end-of-stream, RemoteError if the
-        peer's handler failed."""
+    async def receive(self) -> Message:
+        """Next message (bytes-like: may be a zero-copy memoryview of the wire
+        frame); raises StreamClosedError at end-of-stream, RemoteError if the peer's
+        handler failed."""
         if self._recv_closed:
             raise StreamClosedError(f"stream {self.stream_id}: receive side closed")
         item = await self._inbox.get()
-        if isinstance(item, (bytes, bytearray)) and self._inbox_bytes > 0:
+        if isinstance(item, (bytes, bytearray, memoryview)) and self._inbox_bytes > 0:
             self._inbox_bytes -= len(item)
             self._conn._credit_bytes(len(item))
         if item is _EOF:
@@ -141,18 +146,18 @@ class MuxStream:
             raise item
         return item
 
-    async def __aiter__(self) -> AsyncIterator[bytes]:
+    async def __aiter__(self) -> AsyncIterator[Message]:
         while True:
             try:
                 yield await self.receive()
             except StreamClosedError:
                 return
 
-    def iter_messages(self) -> AsyncIterator[bytes]:
+    def iter_messages(self) -> AsyncIterator[Message]:
         return self.__aiter__()
 
     def _push(self, item) -> None:
-        if isinstance(item, (bytes, bytearray)):
+        if isinstance(item, (bytes, bytearray, memoryview)):
             self._inbox_bytes += len(item)
         self._inbox.put_nowait(item)  # unbounded: never blocks the read loop
 
@@ -208,22 +213,26 @@ class MuxConnection:
         self._next_stream_id += 2
         stream = MuxStream(self, stream_id, handler_name)
         self._streams[stream_id] = stream
-        payload = handler_name.encode("utf-8")
         if trace_context is not None:
-            payload += b"\x00" + trace_context
-        await self.send_frame(stream_id, Flags.OPEN, payload)
+            await self.send_frame(
+                stream_id, Flags.OPEN, handler_name.encode("utf-8"), b"\x00", trace_context
+            )
+        else:
+            await self.send_frame(stream_id, Flags.OPEN, handler_name.encode("utf-8"))
         return stream
 
     @property
     def num_streams(self) -> int:
         return len(self._streams)
 
-    async def send_frame(self, stream_id: int, flags: Flags, payload: bytes) -> None:
+    async def send_frame(self, stream_id: int, flags: Flags, *payload: bytes) -> None:
+        """Send one frame; the payload may arrive as several buffers which travel
+        scatter-gather all the way into the AEAD (no header+payload concat here)."""
         if self._closed:
             raise StreamClosedError(f"connection to {self.peer_id} is closed")
         self.last_used = time.monotonic()
         try:
-            await self._channel.send(_HEADER.pack(stream_id, int(flags)) + payload)
+            await self._channel.send(_HEADER.pack(stream_id, int(flags)), *payload)
         except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
             await self._shutdown(e)
             raise StreamClosedError(f"connection to {self.peer_id} lost: {e}") from e
@@ -234,7 +243,9 @@ class MuxConnection:
             while True:
                 frame = await self._channel.recv()
                 stream_id, flags = _HEADER.unpack_from(frame)
-                payload = frame[_HEADER.size :]
+                # zero-copy: DATA payloads ride to their consumer as a view of the
+                # decrypted frame instead of re-materializing frame[9:] per message
+                payload = memoryview(frame)[_HEADER.size :]
                 await self._dispatch(stream_id, Flags(flags), payload)
         except (ConnectionError, OSError, asyncio.IncompleteReadError, EOFError) as e:
             error = e
@@ -246,7 +257,9 @@ class MuxConnection:
         finally:
             await self._shutdown(error)
 
-    async def _dispatch(self, stream_id: int, flags: Flags, payload: bytes) -> None:
+    async def _dispatch(self, stream_id: int, flags: Flags, payload) -> None:
+        # ``payload`` is a memoryview into the decrypted frame; the rare control
+        # frames (OPEN/ERROR) materialize it, DATA frames pass the view through
         self.last_used = time.monotonic()
         if flags & Flags.OPEN:
             # a remote OPEN must use the REMOTE side's id parity and a fresh id: a
@@ -261,7 +274,7 @@ class MuxConnection:
                 )
                 await self.send_frame(stream_id, Flags.RESET, b"")
                 return
-            name_bytes, _nul, trace_raw = payload.partition(b"\x00")
+            name_bytes, _nul, trace_raw = bytes(payload).partition(b"\x00")
             handler_name = name_bytes.decode("utf-8", errors="replace")
             stream = MuxStream(self, stream_id, handler_name)
             if trace_raw:
@@ -285,7 +298,7 @@ class MuxConnection:
             stream._push(payload)
         if flags & Flags.ERROR:
             try:
-                info = MSGPackSerializer.loads(payload)
+                info = MSGPackSerializer.loads(bytes(payload))
                 stream._push(RemoteError(info.get("type", "RemoteError"), info.get("message", "")))
             except Exception:
                 stream._push(RemoteError("RemoteError", "malformed error payload"))

@@ -112,7 +112,8 @@ def _parse(message_bytes: bytes, message_type: Optional[Type]):
 
 
 def _serialize(message) -> bytes:
-    if isinstance(message, (bytes, bytearray)):
+    # memoryview included: raw handlers may echo the zero-copy wire view back
+    if isinstance(message, (bytes, bytearray, memoryview)):
         return bytes(message)
     return message.SerializeToString()
 

@@ -250,7 +250,8 @@ class ChaosEngine:
 
     @staticmethod
     def _corrupt(payload, rng: random.Random):
-        if isinstance(payload, (bytes, bytearray)) and len(payload):
+        # memoryview included: the mux delivers zero-copy views of wire frames
+        if isinstance(payload, (bytes, bytearray, memoryview)) and len(payload):
             corrupted = bytearray(payload)
             for _ in range(max(1, len(corrupted) // 256)):
                 corrupted[rng.randrange(len(corrupted))] ^= 0xFF

@@ -74,15 +74,42 @@ def _shrink_to_fit(snapshot: Dict[str, Any], max_bytes: int = _MAX_SNAPSHOT_BYTE
             if len(MSGPackSerializer.dumps(snapshot)) <= max_bytes:
                 return snapshot
     metrics = dict(snapshot.get("metrics", {}))
-    # histograms are the bulky families; their count/sum alone usually suffices
-    # for the swarm view, so drop the largest families until the record fits
+    # per-label series are the bulk; the swarm view only ever aggregates a
+    # family's totals, so COMPACT the largest families to one summed series
+    # before dropping anything — every family stays visible swarm-wide
     by_size = sorted(metrics, key=lambda name: -len(str(metrics[name])))
     for name in by_size:
+        metrics[name] = _compact_family(metrics[name])
+        shrunk = {**snapshot, "metrics": metrics, "truncated": True}
+        if len(MSGPackSerializer.dumps(shrunk)) <= max_bytes:
+            return shrunk
+    # still too big (pathological family count): drop largest families outright
+    for name in sorted(metrics, key=lambda name: -len(str(metrics[name]))):
         metrics.pop(name)
         shrunk = {**snapshot, "metrics": metrics, "truncated": True}
         if len(MSGPackSerializer.dumps(shrunk)) <= max_bytes:
             return shrunk
     return {**snapshot, "metrics": {}, "truncated": True}
+
+
+def _compact_family(family: Dict[str, Any]) -> Dict[str, Any]:
+    """Collapse a family's per-label series into one aggregate series (same shape
+    the aggregator consumes, so totals survive label-free)."""
+    series = family.get("series") or {}
+    if len(series) <= 1:
+        return family
+    if family.get("type") == "histogram":
+        merged: Dict[str, float] = {"count": 0.0, "sum": 0.0}
+        for value in series.values():
+            if isinstance(value, dict):
+                merged["count"] += float(value.get("count", 0))
+                merged["sum"] = round(merged["sum"] + float(value.get("sum", 0.0)), 6)
+        return {**family, "series": {"": merged}, "compacted": True}
+    total = 0.0
+    for value in series.values():
+        if not isinstance(value, dict):
+            total += float(value)
+    return {**family, "series": {"": round(total, 6)}, "compacted": True}
 
 
 class TelemetryPublisher:

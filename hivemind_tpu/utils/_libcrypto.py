@@ -111,8 +111,10 @@ _lib.EVP_chacha20_poly1305.restype = ctypes.c_void_p
 _lib.EVP_CipherInit_ex.argtypes = [
     ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
 ]
+# the output parameter is void* (not char*) so multi-part sealing can write each
+# piece at an offset into one ciphertext buffer via addressof()+offset
 _lib.EVP_CipherUpdate.argtypes = [
-    ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int), ctypes.c_char_p, ctypes.c_int,
+    ctypes.c_void_p, ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.c_char_p, ctypes.c_int,
 ]
 _lib.EVP_CipherFinal_ex.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int)]
 _lib.EVP_CIPHER_CTX_ctrl.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_void_p]
@@ -385,7 +387,12 @@ class ChaCha20Poly1305:
             raise ValueError("ChaCha20Poly1305 key must be 32 bytes")
         self._key = bytes(key)
 
-    def _run(self, encrypt: bool, nonce: bytes, data: bytes, aad: Optional[bytes], tag: Optional[bytes]):
+    def _run(self, encrypt: bool, nonce: bytes, data, aad: Optional[bytes], tag: Optional[bytes]):
+        """``data`` is one bytes-like object or a sequence of them; multi-part input
+        is streamed through EVP_CipherUpdate piecewise (scatter-gather: no plaintext
+        join — the only allocation is the contiguous ciphertext output)."""
+        parts = [data] if isinstance(data, (bytes, bytearray, memoryview)) else list(data)
+        total_in = sum(len(part) for part in parts)
         ctx = _lib.EVP_CIPHER_CTX_new()
         if not ctx:
             raise ValueError("libcrypto: no cipher context")
@@ -399,11 +406,19 @@ class ChaCha20Poly1305:
             outlen = ctypes.c_int(0)
             if aad:
                 _check(_lib.EVP_CipherUpdate(ctx, None, ctypes.byref(outlen), bytes(aad), len(aad)), "aad")
-            out = ctypes.create_string_buffer(len(data) if data else 1)
+            out = ctypes.create_string_buffer(total_in if total_in else 1)
             total = 0
-            if data:
-                _check(_lib.EVP_CipherUpdate(ctx, out, ctypes.byref(outlen), bytes(data), len(data)), "update")
-                total = outlen.value
+            out_address = ctypes.addressof(out)
+            for part in parts:
+                if not len(part):
+                    continue
+                _check(
+                    _lib.EVP_CipherUpdate(
+                        ctx, out_address + total, ctypes.byref(outlen), bytes(part), len(part)
+                    ),
+                    "update",
+                )
+                total += outlen.value
             if not encrypt:
                 tag_buf = ctypes.create_string_buffer(bytes(tag), self._TAG_LEN)
                 _check(_lib.EVP_CIPHER_CTX_ctrl(ctx, _EVP_CTRL_AEAD_SET_TAG, self._TAG_LEN, tag_buf), "set_tag")
@@ -422,6 +437,11 @@ class ChaCha20Poly1305:
 
     def encrypt(self, nonce: bytes, data: bytes, associated_data: Optional[bytes]) -> bytes:
         return self._run(True, nonce, data, associated_data, None)
+
+    def encrypt_parts(self, nonce: bytes, parts, associated_data: Optional[bytes]) -> bytes:
+        """Seal a frame whose plaintext is the concatenation of ``parts`` without
+        joining them first (SecureChannel's scatter-gather send path)."""
+        return self._run(True, nonce, parts, associated_data, None)
 
     def decrypt(self, nonce: bytes, data: bytes, associated_data: Optional[bytes]) -> bytes:
         if len(data) < self._TAG_LEN:

@@ -1,0 +1,19 @@
+"""Wires tools/check_hotpath_copies.py into the suite (ISSUE 6 satellite): a new
+bytes concat or implicit-copy astype in the averaging hot path fails tier-1."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+import check_hotpath_copies
+
+
+def test_no_new_hotpath_copies():
+    new, stale = check_hotpath_copies.check()
+    assert not new, (
+        "new copy/concat sites in the averaging hot path "
+        "(see tools/check_hotpath_copies.py):\n" + "\n".join(new)
+    )
+    for entry in stale:
+        print(f"note: stale hot-path allowlist entry: {entry}")

@@ -1,0 +1,153 @@
+#!/usr/bin/env python3
+"""Lint: keep the averaging hot path copy-free (ISSUE 6 satellite).
+
+The throughput work in ISSUE 6 removed per-part byte concats and always-copy
+``astype`` calls from the tensor→wire pipeline. This lint keeps them out of the
+four hot-path files:
+
+    p2p/mux.py, p2p/crypto_channel.py, averaging/partition.py, averaging/allreduce.py
+
+Rules:
+
+1. ``bytes-concat`` — a ``+`` expression whose operand is recognizably bytes
+   (a bytes literal, ``struct``'s ``.pack(...)``, ``.tobytes()``,
+   ``.SerializeToString()``, ``.to_bytes()``, or ``bytes(...)``): on the frame
+   path this doubles megabyte payloads. Use scatter-gather instead —
+   ``send_frame(id, flags, *buffers)`` / ``SecureChannel.send(header, payload)``.
+2. ``copy-astype`` — an ``.astype(...)`` call without an explicit ``copy=``
+   keyword: ``astype`` copies even when the dtype already matches. Spell out
+   ``astype(..., copy=False)`` (or ``copy=True`` where a copy is the point).
+
+Findings are keyed ``(relative path, enclosing def, kind)`` — stable across
+line-number churn. Reviewed occurrences (small control-plane frames, handshake
+transcripts) are grandfathered in ``ALLOWLIST``; the wired-in test fails on
+anything NEW and warns on stale entries so the list shrinks over time.
+
+Run directly (``python tools/check_hotpath_copies.py``) or via
+``tests/test_hotpath_copies_lint.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List, Set, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PACKAGE_ROOT = REPO_ROOT / "hivemind_tpu"
+
+HOT_FILES = (
+    "p2p/mux.py",
+    "p2p/crypto_channel.py",
+    "averaging/partition.py",
+    "averaging/allreduce.py",
+)
+
+Finding = Tuple[str, str, str]  # (relpath, enclosing function, kind)
+
+# Reviewed occurrences. Do not add hot-loop sites here — route large payloads
+# through the scatter-gather framing instead.
+ALLOWLIST: Set[Finding] = {
+    # handshake control plane: tiny transcript/hello/upgrade frames, never per-part
+    ("p2p/crypto_channel.py", "_send_plain", "bytes-concat"),
+    ("p2p/crypto_channel.py", "handshake._run", "bytes-concat"),
+}
+
+_BYTES_PRODUCING_METHODS = {"pack", "tobytes", "SerializeToString", "to_bytes"}
+
+
+def _is_bytes_typed(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, bytes):
+        return True
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _BYTES_PRODUCING_METHODS:
+            return True
+        if isinstance(fn, ast.Name) and fn.id == "bytes":
+            return True
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return _is_bytes_typed(node.left) or _is_bytes_typed(node.right)
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, relpath: str):
+        self.relpath = relpath
+        self.findings: List[Tuple[Finding, int]] = []
+        self._scope: List[str] = []
+
+    # --- scope tracking -------------------------------------------------
+    def _visit_scoped(self, node):
+        self._scope.append(node.name)
+        self.generic_visit(node)
+        self._scope.pop()
+
+    visit_FunctionDef = visit_AsyncFunctionDef = visit_ClassDef = _visit_scoped
+
+    def _qualname(self) -> str:
+        return ".".join(self._scope) if self._scope else "<module>"
+
+    def _record(self, kind: str, lineno: int) -> None:
+        self.findings.append(((self.relpath, self._qualname(), kind), lineno))
+
+    # --- rules ----------------------------------------------------------
+    def visit_BinOp(self, node: ast.BinOp):
+        if isinstance(node.op, ast.Add) and (
+            _is_bytes_typed(node.left) or _is_bytes_typed(node.right)
+        ):
+            self._record("bytes-concat", node.lineno)
+            # one finding per outermost concat chain: do not descend further
+            return
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "astype":
+            if not any(keyword.arg == "copy" for keyword in node.keywords):
+                self._record("copy-astype", node.lineno)
+        self.generic_visit(node)
+
+
+def collect_findings(package_root: Path = PACKAGE_ROOT) -> List[Tuple[Finding, int]]:
+    findings: List[Tuple[Finding, int]] = []
+    for relpath in HOT_FILES:
+        path = package_root / relpath
+        tree = ast.parse(path.read_text(), filename=str(path))
+        visitor = _Visitor(relpath)
+        visitor.visit(tree)
+        findings.extend(visitor.findings)
+    return findings
+
+
+def check(package_root: Path = PACKAGE_ROOT) -> Tuple[List[str], List[str]]:
+    """Returns (new_violations, stale_allowlist_entries) as printable strings."""
+    found = collect_findings(package_root)
+    found_keys = {key for key, _lineno in found}
+    new = [
+        f"{key[0]}:{lineno} [{key[2]}] in {key[1]} — "
+        + ("pass buffers scatter-gather (send_frame/SecureChannel.send varargs)"
+           if key[2] == "bytes-concat"
+           else "spell out astype(..., copy=False) on the hot path")
+        for key, lineno in sorted(found)
+        if key not in ALLOWLIST
+    ]
+    stale = [f"{entry[0]} [{entry[2]}] in {entry[1]}" for entry in sorted(ALLOWLIST - found_keys)]
+    return new, stale
+
+
+def main() -> int:
+    new, stale = check()
+    for entry in stale:
+        print(f"note: stale allowlist entry (cleaned up — remove it): {entry}")
+    if new:
+        print(f"{len(new)} new copy/concat site(s) in the averaging hot path:")
+        for violation in new:
+            print(f"  {violation}")
+        return 1
+    print("ok: no byte concats or implicit-copy astype calls in the hot path")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
