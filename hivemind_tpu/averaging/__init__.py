@@ -12,3 +12,15 @@ from hivemind_tpu.averaging.partition import (
     TensorPartReducer,
 )
 from hivemind_tpu.averaging.slice import SliceAverager
+from hivemind_tpu.averaging.state_sync import (
+    DigestMismatch,
+    ManifestMismatch,
+    StaleDonor,
+    StateAssembly,
+    StateDownloadResult,
+    StateSyncError,
+    StateUnavailable,
+    build_state_manifest,
+    download_state_verified,
+    payload_digest,
+)

@@ -13,8 +13,8 @@ import asyncio
 import contextlib
 import hashlib
 import os
-import random
 import threading
+import time
 from typing import Any, AsyncIterator, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -26,6 +26,13 @@ from hivemind_tpu.averaging.key_manager import GroupKeyManager
 from hivemind_tpu.averaging.load_balancing import load_balance_peers
 from hivemind_tpu.averaging.matchmaking import Matchmaking, MatchmakingException
 from hivemind_tpu.averaging.partition import AllreduceException, DEFAULT_PART_SIZE_BYTES
+from hivemind_tpu.averaging.state_sync import (
+    STATE_CHUNK_BYTES,
+    STATE_SYNC_BYTES_SENT as _STATE_SYNC_BYTES_SENT,
+    StateDownloadResult,
+    build_state_manifest,
+    download_state_verified,
+)
 from hivemind_tpu.compression import (
     CompressionBase,
     NoCompression,
@@ -247,6 +254,7 @@ class DecentralizedAverager(ServicerBase):
         async def _teardown():
             if self._declare_state_task is not None:
                 self._declare_state_task.cancel()
+                await self._retract_state_declaration()
             warmup_task = getattr(self, "_warmup_task", None)
             if warmup_task is not None:
                 warmup_task.cancel()
@@ -583,18 +591,149 @@ class DecentralizedAverager(ServicerBase):
         the averaged tensors (reference get_current_state)."""
         return None, self._snapshot_tensors()
 
+    # serialized-state snapshots are shared across concurrent downloads for this
+    # long: striping probes + two stripe streams pay ONE serialize+digest pass,
+    # and the manifest always matches the exact bytes streamed
+    state_snapshot_ttl: float = 1.0
+
+    async def _serialized_state_snapshot(self):
+        """(metadata_blob, serialized tensors, manifest), built at most once per
+        TTL window. Concurrent callers (striping probes + stripe streams + other
+        joiners) await ONE shared task instead of each running their own full
+        serialize+digest pass — otherwise N concurrent downloads would hold N
+        serialized state copies in donor memory. The expiry is anchored at pass
+        COMPLETION (a multi-GB pass takes seconds; anchoring at the start would
+        publish an already-expired cache), and the pass runs in an executor so
+        the event loop keeps serving matchmaking/allreduce meanwhile."""
+        entry = getattr(self, "_state_snapshot_entry", None)
+        if entry is not None:
+            task, expiry_box = entry
+            if not task.done():
+                reusable = True  # join the in-flight pass
+            elif task.cancelled() or task.exception() is not None:
+                reusable = False  # failed pass: rebuild for this caller
+            else:
+                reusable = expiry_box[0] is not None and time.monotonic() < expiry_box[0]
+            if reusable:
+                return await task
+        expiry_box: List[Optional[float]] = [None]
+        task = asyncio.get_event_loop().create_task(self._build_state_snapshot(expiry_box))
+        self._state_snapshot_entry = (task, expiry_box)
+        return await task
+
+    async def _build_state_snapshot(self, expiry_box):
+        metadata, tensors = await self._get_current_state()
+        metadata_blob = MSGPackSerializer.dumps(metadata)
+        epoch = int(metadata["epoch"]) if isinstance(metadata, dict) and "epoch" in metadata else 0
+
+        def _serialize_and_digest():
+            serialized = [serialize_tensor(tensor, self.state_compression) for tensor in tensors]
+            manifest = build_state_manifest(
+                serialized, schema_hash=self.schema_hash, epoch=epoch, metadata=metadata_blob
+            )
+            return serialized, manifest
+
+        loop = asyncio.get_event_loop()
+        serialized, manifest = await loop.run_in_executor(None, _serialize_and_digest)
+        expiry_box[0] = time.monotonic() + self.state_snapshot_ttl
+
+        # the cache must not pin a full serialized state copy forever: drop the
+        # entry shortly after its TTL unless a newer snapshot replaced it
+        def _drop_if_expired():
+            current = getattr(self, "_state_snapshot_entry", None)
+            if (
+                current is not None
+                and current[1][0] is not None
+                and time.monotonic() >= current[1][0]
+            ):
+                self._state_snapshot_entry = None
+
+        loop.call_later(self.state_snapshot_ttl + 0.1, _drop_if_expired)
+        return metadata_blob, serialized, manifest
+
     async def rpc_download_state(
         self, request: averaging_pb2.DownloadRequest, context: P2PContext
     ) -> AsyncIterator[averaging_pb2.DownloadData]:
-        """Stream (metadata, tensors) to a joining peer (reference averager.py:628-651)."""
+        """Manifest-first state stream (reference averager.py:628-651, hardened per
+        ISSUE 7): the first message carries a :class:`StateManifest` — schema
+        fingerprint, donor epoch, per-tensor length + digest — so the receiver can
+        verify every tensor as it lands, resume across donors, and distinguish
+        "sharing disabled" from a truncated stream. ``request.have_tensors`` names
+        already-verified tensors the receiver does not need again."""
         if not self._allow_state_sharing:
+            # explicit refusal: a clean "no" must never look like a dead donor
+            yield averaging_pb2.DownloadData(
+                manifest=averaging_pb2.StateManifest(state_unavailable=True)
+            )
             return
-        metadata, tensors = await self._get_current_state()
-        yield averaging_pb2.DownloadData(metadata=MSGPackSerializer.dumps(metadata))
-        for tensor in tensors:
-            serialized = serialize_tensor(tensor, self.state_compression)
-            for chunk in split_tensor_for_streaming(serialized, 2**20):
-                yield averaging_pb2.DownloadData(tensor_part=chunk)
+        metadata_blob, serialized, manifest = await self._serialized_state_snapshot()
+        # legacy ``metadata`` field kept alongside the manifest for old readers
+        yield averaging_pb2.DownloadData(manifest=manifest, metadata=metadata_blob)
+        if request.manifest_only:
+            return
+        have = set(request.have_tensors)
+        donor_scope = str(self.peer_id)
+        for index, tensor in enumerate(serialized):
+            if index in have:
+                continue
+            for chunk in split_tensor_for_streaming(tensor, STATE_CHUNK_BYTES):
+                if _CHAOS.enabled:  # injection point: donor dies / corrupts mid-stream
+                    payload = chunk.buffer
+                    injected = await _CHAOS.inject(
+                        "state.download.send", payload=payload, scope=donor_scope
+                    )
+                    if injected is not payload:
+                        chunk.buffer = injected
+                _STATE_SYNC_BYTES_SENT.inc(len(chunk.buffer))
+                yield averaging_pb2.DownloadData(tensor_part=chunk, tensor_index=index)
+
+    @classmethod
+    async def _download_verified_async(
+        cls,
+        dht: DHT,
+        p2p: P2P,
+        prefix: str,
+        *,
+        exclude_peer_id: Optional[PeerID] = None,
+        timeout: Optional[float] = None,
+        expected_tensors: Optional[int] = None,
+        schema_hash: Optional[str] = None,
+        min_epoch: Optional[int] = None,
+    ) -> Optional[StateDownloadResult]:
+        """Verified, resumable, optionally striped state download from the donors
+        declared under ``{prefix}.all_averagers`` (state_sync.py, ISSUE 7).
+        Classmethod on purpose: peers that do not yet KNOW the tensor schema
+        (auxiliary helpers) can bootstrap it from the swarm before constructing
+        their averager (reference aux peers are schema-free)."""
+
+        def _count_donor_failure(donor, exc) -> None:
+            # ISSUE 7 satellite: a swarm where EVERY donor fails must be visible —
+            # each failed attempt is counted (state_sync already logs a warning).
+            # Clean protocol answers (sharing disabled / stale epoch) are not
+            # errors and carry their own dedicated counters.
+            from hivemind_tpu.averaging.state_sync import StaleDonor, StateUnavailable
+
+            if not isinstance(exc, (StaleDonor, StateUnavailable)):
+                _AVERAGER_INTERNAL_ERRORS.inc(site="state_download")
+
+        result = await download_state_verified(
+            dht, p2p, prefix, cls.get_stub,
+            exclude_peer_id=exclude_peer_id,
+            timeout=timeout,
+            expected_tensors=expected_tensors,
+            schema_hash=schema_hash,
+            min_epoch=min_epoch,
+            on_donor_failure=_count_donor_failure,
+        )
+        if result is None:
+            logger.warning(f"could not download state for {prefix!r} from any peer")
+            return None
+        logger.info(
+            f"downloaded state for {prefix!r} from {result.donors} at epoch {result.epoch} "
+            f"({'digest-verified' if result.verified else 'UNVERIFIED legacy stream'}, "
+            f"{result.bytes_received} bytes)"
+        )
+        return result
 
     @classmethod
     async def _download_state_async(
@@ -607,75 +746,39 @@ class DecentralizedAverager(ServicerBase):
         timeout: Optional[float] = None,
         expected_tensors: Optional[int] = None,
     ) -> Optional[Tuple[Any, List[np.ndarray]]]:
-        """Fetch (metadata, tensors) from the best-priority peer declared under
-        ``{prefix}.all_averagers``. Classmethod on purpose: peers that do not yet
-        KNOW the tensor schema (auxiliary helpers) can bootstrap it from the swarm
-        before constructing their averager (reference aux peers are schema-free)."""
-        key = f"{prefix}.all_averagers"
-        result = await dht.node.get(key, latest=True)
-        candidates = []
-        if result is not None and isinstance(result.value, dict):
-            for subkey, entry in result.value.items():
-                try:
-                    peer_id = PeerID.from_base58(subkey)
-                    priority = entry.value
-                    if peer_id != exclude_peer_id and isinstance(priority, (int, float, list, tuple)):
-                        candidates.append((priority, random.random(), peer_id))
-                except Exception as e:
-                    # a malformed declaration record (bad base58 subkey / garbage
-                    # priority) — skipping is correct, but it must be visible: a
-                    # swarm full of these means someone is publishing junk under
-                    # our prefix (ISSUE 3 satellite: no silent swallowing)
-                    logger.warning(f"ignoring malformed averager declaration {subkey!r}: {e!r}")
-                    _AVERAGER_INTERNAL_ERRORS.inc(site="state_declaration_parse")
-                    continue
-        candidates.sort(reverse=True)
-        for _priority, _jitter, peer_id in candidates:
-            try:
-                stub = cls.get_stub(p2p, peer_id, namespace=prefix)
-                stream = stub.rpc_download_state(averaging_pb2.DownloadRequest(), timeout=timeout or 60.0)
-                holder: Dict[str, Any] = {}
+        """(metadata, tensors) view of :meth:`_download_verified_async` — the
+        schema-free entry point used by aux bootstrap and old call sites."""
+        result = await cls._download_verified_async(
+            dht, p2p, prefix, exclude_peer_id=exclude_peer_id, timeout=timeout,
+            expected_tensors=expected_tensors,
+        )
+        return None if result is None else (result.metadata, result.tensors)
 
-                async def _tensor_parts():
-                    async for message in stream:
-                        if message.metadata and "metadata" not in holder:
-                            holder["metadata"] = MSGPackSerializer.loads(message.metadata)
-                        if message.HasField("tensor_part"):
-                            yield [message.tensor_part]
-
-                from hivemind_tpu.compression import deserialize_tensor_stream
-
-                tensors = await deserialize_tensor_stream(_tensor_parts())
-                if expected_tensors is not None and len(tensors) != expected_tensors:
-                    # a donor that died mid-download can end its stream CLEANLY
-                    # after a few chunks; a truncated schema must fail over to
-                    # the next candidate, not be returned as "the state"
-                    logger.warning(
-                        f"state download from {peer_id} was truncated "
-                        f"({len(tensors)}/{expected_tensors} tensors); trying the next donor"
-                    )
-                    continue
-                if "metadata" in holder or tensors:
-                    logger.info(f"downloaded state from {peer_id}")
-                    return holder.get("metadata"), tensors
-            except Exception as e:
-                logger.debug(f"state download from {peer_id} failed: {e!r}")
-        logger.warning("could not download state from any peer")
-        return None
-
-    async def _load_state_from_peers_async(self, timeout: Optional[float] = None) -> Optional[Tuple[Any, List[np.ndarray]]]:
+    async def _load_state_from_peers_async(
+        self, timeout: Optional[float] = None, min_epoch: Optional[int] = None
+    ) -> Optional[StateDownloadResult]:
         # an averager KNOWS its schema: donors serving a different tensor count
-        # (truncated mid-download or mismatched run) are skipped in-loop
+        # (truncated mid-download or mismatched run) are rejected at the manifest,
+        # and stale donors (epoch < min_epoch) are skipped before any bytes move.
+        # The manifest's schema fingerprint is NOT pinned here: it embeds the
+        # donor's codec, and heterogeneous-but-compatible donors (e.g. an aux
+        # NoCompression donor feeding a Float16 state averager) are a designed
+        # pattern — integrity comes from the per-tensor digests + tensor count.
         with self.get_tensors() as tensors:
             expected = len(tensors)
-        return await type(self)._download_state_async(
+        return await type(self)._download_verified_async(
             self.dht, self.p2p, self.prefix, exclude_peer_id=self.peer_id, timeout=timeout,
-            expected_tensors=expected,
+            expected_tensors=expected, min_epoch=min_epoch,
         )
 
     def load_state_from_peers(self, timeout: Optional[float] = None, wait: bool = True):
         """Fetch (metadata, tensors) from the best-priority peer sharing state."""
-        future = self._runner.run_coroutine(self._load_state_from_peers_async(timeout), return_future=True)
+
+        async def _tuple_view():
+            result = await self._load_state_from_peers_async(timeout)
+            return None if result is None else (result.metadata, result.tensors)
+
+        future = self._runner.run_coroutine(_tuple_view(), return_future=True)
         return future.result(timeout) if wait else future
 
     @classmethod
@@ -697,12 +800,17 @@ class DecentralizedAverager(ServicerBase):
         while True:
             if self._allow_state_sharing:
                 try:
+                    expiration = get_dht_time() + self.declare_state_period * 2
                     await self.dht.node.store(
                         key,
                         value=self._state_sharing_priority,
-                        expiration_time=get_dht_time() + self.declare_state_period * 2,
+                        expiration_time=expiration,
                         subkey=self.peer_id.to_base58(),
                     )
+                    # remembered so shutdown can retract with a FRESHER record
+                    # (per-subkey stores are newest-expiration-wins; an older
+                    # tombstone would simply be ignored)
+                    self._declared_state_expiration = expiration
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:
@@ -711,6 +819,35 @@ class DecentralizedAverager(ServicerBase):
                     logger.warning(f"could not declare state under {key!r}: {e!r}")
                     _AVERAGER_INTERNAL_ERRORS.inc(site="declare_state")
             await asyncio.sleep(self.declare_state_period)
+
+    async def _retract_state_declaration(self) -> None:
+        """ISSUE 7 satellite: a cleanly-departing donor overwrites its
+        ``{prefix}.all_averagers`` record with a ``None`` tombstone, so joiners
+        stop spending a dial + timeout on a peer that is provably gone. The DHT
+        refuses past-expiration and older-than-existing stores, so the tombstone
+        must be *fresher* than the last declaration; readers filter ``None``."""
+        declared = getattr(self, "_declared_state_expiration", None)
+        if declared is None:
+            return
+        try:
+            # strictly fresher than ANY declaration the loop could have issued —
+            # including one still in flight when the task was cancelled, whose
+            # expiration (its now + 2*period) exceeds the last RECORDED one
+            tombstone_expiration = get_dht_time() + self.declare_state_period * 2 + 1.0
+            await asyncio.wait_for(
+                self.dht.node.store(
+                    f"{self.prefix}.all_averagers",
+                    value=None,
+                    expiration_time=max(tombstone_expiration, declared + 1.0),
+                    subkey=self.peer_id.to_base58(),
+                ),
+                timeout=max(0.5, self.shutdown_timeout / 2),
+            )
+        except Exception as e:
+            # best-effort: joiners fall back to the dial-timeout path they
+            # always had — but a chronically failing retract should be visible
+            logger.warning(f"could not retract state declaration: {e!r}")
+            _AVERAGER_INTERNAL_ERRORS.inc(site="state_retract")
 
     def get_group_bits(self) -> str:
         assert self.key_manager is not None

@@ -1,15 +1,23 @@
-"""Chaos soak (ISSUE 3 acceptance): a small in-process swarm trains under a
-seeded fault schedule covering every named injection point, then the faults
-stop and the soak asserts the swarm LIVED through it:
+"""Chaos soak (ISSUE 3 acceptance; churn phase ISSUE 7): a small in-process
+swarm trains under a seeded fault schedule covering every named injection
+point, then the faults stop and the soak asserts the swarm LIVED through it:
 
 - every peer's optimizer step count (and epoch) keeps advancing,
 - the MoE client keeps getting expert responses after the faults stop,
 - every circuit breaker tripped during the storm returns to closed,
-- every named injection point actually saw traffic.
+- every named injection point actually saw traffic,
+- with ``--churn``: peers are crash-killed on a seeded schedule (their DHT
+  yanked mid-round, no shutdown, state declarations left dangling) and
+  restarted with a local checkpoint directory — the verdict then requires
+  ``state_recovered: true`` (every restarted peer back at the tracker's global
+  epoch via digest-verified state) and ``digest_failures_adopted: 0`` (chaos
+  corrupted payloads on ``state.download.*``, and not one unverified tensor
+  was ever adopted).
 
 Run it::
 
     python -m hivemind_tpu.hivemind_cli.run_chaos_soak --peers 4 --duration 60
+    python -m hivemind_tpu.hivemind_cli.run_chaos_soak --peers 4 --duration 60 --churn
 
 or programmatically via :func:`run_soak` (the chaos-marked tests use a short
 configuration of the same function). The schedule is deterministic per seed —
@@ -21,6 +29,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import tempfile
 import threading
 import time
 from typing import Dict, List, Optional
@@ -46,6 +55,10 @@ DEFAULT_SCHEDULE = (
     ("allreduce.reduce", "abort", dict(prob=0.02)),
     ("moe.forward", "drop", dict(prob=0.25)),
     ("moe.backward", "drop", dict(prob=0.25)),
+    # the recovery path under fire (ISSUE 7): corrupted donor payloads must be
+    # caught by digest verification, dropped streams must resume via failover
+    ("state.download.send", "corrupt_payload", dict(prob=0.2)),
+    ("state.download.recv", "drop", dict(prob=0.1)),
 )
 
 
@@ -81,25 +94,42 @@ def run_soak(
     chaos_fraction: float = 0.6,
     include_moe: bool = True,
     spec: Optional[str] = None,
+    churn: bool = False,
+    churn_kills: Optional[int] = None,
+    checkpoint_root: Optional[str] = None,
 ) -> dict:
-    """Run the soak; returns a JSON-able report with an ``ok`` verdict."""
+    """Run the soak; returns a JSON-able report with an ``ok`` verdict.
+
+    With ``churn=True``, ``churn_kills`` peers (default ``max(1, n_peers // 3)``;
+    never peer 0, which anchors the DHT bootstrap and the download prober) are
+    crash-killed on a seeded schedule inside the chaos window and restarted a few
+    seconds later with the same local checkpoint directory.
+    """
+    import random as random_module
+
     import numpy as np
     import optax
 
     import jax.numpy as jnp
 
+    from hivemind_tpu.averaging.state_sync import (
+        _STATE_SYNC_DIGEST_FAILURES,
+        _STATE_SYNC_UNVERIFIED,
+    )
     from hivemind_tpu.dht import DHT
     from hivemind_tpu.moe.client.call_many import EXPERT_BREAKERS
     from hivemind_tpu.optim import Optimizer
 
     report: Dict[str, object] = {
-        "n_peers": n_peers, "duration": duration, "seed": seed, "errors": [],
+        "n_peers": n_peers, "duration": duration, "seed": seed, "churn": churn, "errors": [],
     }
     reset_all_boards()
     # arm the flight recorder for THIS soak: a fresh ring means every chaos
     # span event found at verdict time was injected by this run (ISSUE 4: the
     # chaos engine and the tracer must provably connect)
     RECORDER.clear()
+    digest_failures_before = _STATE_SYNC_DIGEST_FAILURES.value(site="download")
+    unverified_before = _STATE_SYNC_UNVERIFIED.value()
     # the soak's recovery window is short: expert breakers must be probeable
     # within it (the production default is restored in the outer finally)
     original_expert_recovery = EXPERT_BREAKERS._kwargs["recovery_time"]
@@ -110,6 +140,11 @@ def run_soak(
     maddrs = [str(m) for m in first.get_visible_maddrs()]
     dhts: List[DHT] = [first] + [DHT(initial_peers=maddrs, start=True) for _ in range(n_peers - 1)]
 
+    checkpoint_dir_ctx = None
+    if churn and checkpoint_root is None:
+        checkpoint_dir_ctx = tempfile.TemporaryDirectory(prefix="chaos_soak_ckpt_")
+        checkpoint_root = checkpoint_dir_ctx.name
+
     server = None
     moe_stats = {"ok_during": 0, "ok_after": 0, "calls": 0}
     stop_event = threading.Event()
@@ -118,28 +153,50 @@ def run_soak(
     step_counts: Dict[int, int] = {index: 0 for index in range(n_peers)}
     epochs: Dict[int, int] = {index: 0 for index in range(n_peers)}
 
+    class _TrainerSlot:
+        def __init__(self, index: int, dht: DHT):
+            self.index = index
+            self.dht = dht
+            self.kill = threading.Event()  # crash simulation: NO clean shutdown
+            self.opt = None
+            self.thread: Optional[threading.Thread] = None
+            self.restarts = 0
+
+    slots: Dict[int, _TrainerSlot] = {index: _TrainerSlot(index, dht) for index, dht in enumerate(dhts)}
+    dead_peer_ids: List[str] = []  # breakers for these ids legitimately stay open
+    retired_threads: List[threading.Thread] = []  # crash-killed trainers, still joined at exit
+
     features, targets, loss_and_grad = _toy_problem(seed)
 
-    def run_trainer(index: int, dht: DHT) -> None:
+    def run_trainer(slot: _TrainerSlot) -> None:
         try:
             opt = Optimizer(
-                dht=dht, run_id="chaos_soak", target_batch_size=64,
+                dht=slot.dht, run_id="chaos_soak", target_batch_size=64,
                 params={"w": jnp.zeros(8, jnp.float32)}, optimizer=optax.sgd(0.2),
                 batch_size_per_step=16, matchmaking_time=1.5, averaging_timeout=20,
                 average_state_every=1, target_group_size=2, verbose=False,
+                load_state_timeout=15,
+                checkpoint_dir=(
+                    f"{checkpoint_root}/peer{slot.index}" if checkpoint_root is not None else None
+                ),
                 tracker_opts=dict(min_refresh_period=0.3, default_refresh_period=0.5),
             )
-            rng_local = np.random.RandomState(index)
-            while not stop_event.is_set():
+            slot.opt = opt
+            rng_local = np.random.RandomState(slot.index + 101 * slot.restarts)
+            while not stop_event.is_set() and not slot.kill.is_set():
                 batch = rng_local.choice(len(features), 16)
                 _loss, grads = loss_and_grad(opt.params, features[batch], targets[batch])
                 opt.step(grads)
-                step_counts[index] += 1
-                epochs[index] = opt.local_epoch
+                step_counts[slot.index] += 1
+                epochs[slot.index] = opt.local_epoch
                 time.sleep(0.25)
+            if slot.kill.is_set():
+                return  # kill -9 semantics: no opt.shutdown(), declarations left dangling
             opt.shutdown()
         except Exception as e:
-            errors.append(f"trainer {index}: {e!r}")
+            if slot.kill.is_set():
+                return  # expected: the DHT was yanked out from under a live step
+            errors.append(f"trainer {slot.index}: {e!r}")
 
     def run_moe_client(client_dht: DHT, expert_uids) -> None:
         from hivemind_tpu.moe import RemoteExpert, get_experts
@@ -178,12 +235,117 @@ def run_soak(
                 await node.protocol.call_ping(contacts[0][1].peer_id)
 
         while not stop_event.is_set():
-            for dht in dhts:
+            for slot in slots.values():
+                if slot.kill.is_set():
+                    continue
                 try:
-                    dht.run_coroutine(ping_one_neighbor)
+                    slot.dht.run_coroutine(ping_one_neighbor)
                 except Exception as e:
                     logger.debug(f"soak pinger: {e!r}")
             time.sleep(1.0)
+
+    def run_downloader() -> None:
+        """Periodic verified state downloads keep the state.download.* injection
+        points exercised even before any peer falls behind: the prober pulls the
+        trainers' shared state exactly the way a joining peer would."""
+        from hivemind_tpu.averaging.averager import DecentralizedAverager
+
+        async def _probe(_dht, _node):
+            p2p = await _dht.replicate_p2p()
+            return await DecentralizedAverager._download_verified_async(
+                _dht, p2p, "chaos_soak_state", exclude_peer_id=_dht.peer_id, timeout=6.0
+            )
+
+        while not stop_event.is_set():
+            slot = slots[0]  # never churn-killed: its DHT outlives the soak
+            try:
+                slot.dht.run_coroutine(_probe)
+            except Exception as e:
+                logger.debug(f"soak downloader: {e!r}")
+            for _ in range(4):
+                if stop_event.is_set():
+                    return
+                time.sleep(0.5)
+
+    def _spawn_joined_dht(rng) -> Optional[DHT]:
+        """A fresh DHT that actually JOINED the swarm: with chaos dropping DHT
+        RPCs, a single bootstrap attempt can fail silently and leave the node
+        isolated forever (empty routing table) — a rebooted machine would retry
+        its bootstrap too, so the churn restart does."""
+
+        async def _table_size(_dht, node):
+            return len(list(node.protocol.routing_table.iter_nodes()))
+
+        for _attempt in range(6):
+            candidate = None
+            try:
+                # construction itself throws when chaos eats the bootstrap pings
+                candidate = DHT(initial_peers=maddrs, start=True)
+                if candidate.run_coroutine(_table_size) > 0:
+                    return candidate
+            except Exception as e:
+                logger.debug(f"churn bootstrap attempt failed: {e!r}")
+            if candidate is not None:
+                candidate.shutdown()
+            if stop_event.wait(rng.uniform(0.5, 1.5)):
+                return None
+        return None
+
+    def run_churn(chaos_window: float) -> None:
+        """Seeded kill/restart schedule: each kill yanks the victim's DHT with no
+        shutdown (mid-round, possibly mid-download for its downloaders), then
+        restarts the peer on a fresh DHT with the same checkpoint directory."""
+        rng = random_module.Random(seed + 0xC0FFEE)
+        kills = churn_kills if churn_kills is not None else max(1, n_peers // 3)
+        kill_times = sorted(rng.uniform(0.25, 0.7) * chaos_window for _ in range(kills))
+        start = time.monotonic()
+        # peer 0 anchors the DHT bootstrap + download prober; the last peer's DHT
+        # is the MoE client's transport — killing it would orphan the client's
+        # RemoteExperts for the rest of the soak and fail moe_recovered
+        last_victim = n_peers - 1 if include_moe else n_peers
+        victims = [index for index in range(1, last_victim)]
+        if not victims:
+            errors.append("churn: no eligible victims (need more peers for this configuration)")
+            return
+        for kill_time in kill_times:
+            delay = start + kill_time - time.monotonic()
+            if delay > 0:
+                if stop_event.wait(delay):
+                    return
+            candidates = [i for i in victims if not slots[i].kill.is_set()]
+            # quorum counts LIVE slots (restarted peers are alive again) — the
+            # cumulative dead_peer_ids list exists for breaker bookkeeping only
+            live = sum(1 for slot in slots.values() if not slot.kill.is_set())
+            if len(candidates) < 1 or live <= 2:
+                continue  # keep a quorum able to form groups
+            index = rng.choice(candidates)
+            slot = slots[index]
+            logger.warning(f"churn: crash-killing trainer {index}")
+            slot.kill.set()
+            try:
+                dead_peer_ids.append(str(slot.dht.peer_id))
+                slot.dht.shutdown()  # the "power cord": transport dies instantly
+            except Exception as e:
+                logger.debug(f"churn kill {index}: {e!r}")
+            if stop_event.wait(rng.uniform(2.0, 4.0)):
+                return
+            logger.warning(f"churn: restarting trainer {index}")
+            try:
+                new_dht = _spawn_joined_dht(rng)
+            except Exception as e:
+                errors.append(f"churn restart {index}: {e!r}")
+                continue
+            if new_dht is None:
+                if not stop_event.is_set():
+                    errors.append(f"churn restart {index}: could not rejoin the swarm")
+                continue
+            new_slot = _TrainerSlot(index, new_dht)
+            new_slot.restarts = slot.restarts + 1
+            if slot.thread is not None:
+                retired_threads.append(slot.thread)
+            slots[index] = new_slot
+            new_slot.thread = threading.Thread(target=run_trainer, args=(new_slot,))
+            new_slot.thread.start()
 
     threads: List[threading.Thread] = []
     try:
@@ -201,19 +363,23 @@ def run_soak(
                 threads.append(threading.Thread(target=run_moe_client, args=(dhts[-1], expert_uids)))
 
             threads.append(threading.Thread(target=run_pinger))
-            threads.extend(
-                threading.Thread(target=run_trainer, args=(index, dht))
-                for index, dht in enumerate(dhts)
-            )
-            for thread in threads:
+            threads.append(threading.Thread(target=run_downloader))
+            for slot in slots.values():
+                slot.thread = threading.Thread(target=run_trainer, args=(slot,))
+            trainer_threads_initial = [slots[index].thread for index in range(n_peers)]
+            for thread in threads + trainer_threads_initial:
                 thread.start()
 
-            # phase 1: faults armed
+            # phase 1: faults armed (and, with --churn, peers dying)
             if spec:
                 CHAOS.configure(spec, seed=seed)
             else:
                 arm_default_schedule(seed)
             chaos_window = duration * chaos_fraction
+            churn_thread = None
+            if churn:
+                churn_thread = threading.Thread(target=run_churn, args=(chaos_window,))
+                churn_thread.start()
             time.sleep(chaos_window)
             steps_at_chaos_end = dict(step_counts)
             report["chaos_stats"] = CHAOS.stats()
@@ -229,25 +395,69 @@ def run_soak(
             chaos_off_event.set()
             logger.warning("chaos window over: faults disarmed, watching recovery")
 
-            # phase 2: recovery
+            # phase 2: recovery. The base window is fixed; with churn, a BOUNDED
+            # extra wait runs only while a restarted peer still lags the swarm —
+            # on a loaded 1-core CI box, averaging rounds stretch to their full
+            # timeouts and a fixed window flakes on liveness the peer is already
+            # in the middle of demonstrating
             time.sleep(duration - chaos_window)
+            if churn_thread is not None:
+                churn_thread.join(timeout=60)
+
+            def _swarm_global_epoch() -> int:
+                best = 0
+                for slot in slots.values():
+                    if slot.opt is not None and not slot.kill.is_set():
+                        try:
+                            best = max(best, slot.opt.tracker.global_epoch)
+                        except Exception:
+                            continue
+                return best
+
+            def _lagging_restarts() -> List[int]:
+                # the SAME swarm-wide view the verdict uses — a restarted peer's
+                # own tracker can lag the survivors' by an epoch under load, and
+                # waiting on the wrong view flakes the verdict
+                global_now = _swarm_global_epoch()
+                return [
+                    index for index, slot in slots.items()
+                    if slot.restarts > 0
+                    and (slot.opt is None or slot.opt.local_epoch < global_now - 1)
+                ]
+
+            if churn:
+                extra_deadline = time.monotonic() + max(30.0, duration - chaos_window)
+                while time.monotonic() < extra_deadline and _lagging_restarts():
+                    time.sleep(1.0)
+
+            # final swarm view BEFORE teardown: the restarted peers' verdict is
+            # measured against the tracker's global epoch, not a local guess
+            final_global_epoch = _swarm_global_epoch()
         finally:
             stop_event.set()
-            for thread in threads:
+            live_threads = [slot.thread for slot in slots.values() if slot.thread is not None]
+            for thread in threads + live_threads + retired_threads:
                 thread.join(timeout=60)
             if server is not None:
                 server.shutdown()
-            for dht in dhts:
-                dht.shutdown()
+            for slot in slots.values():
+                if not slot.kill.is_set():
+                    slot.dht.shutdown()
 
         # ------------------------------------------------------------ verdict
         tripped = {}
-        for index, dht in enumerate(dhts):
+        for index, slot in slots.items():
+            if slot.kill.is_set():
+                continue
             try:
-                blacklist = dht.node.blacklist
+                blacklist = slot.dht.node.blacklist
             except Exception:
                 continue
-            tripped[f"dht_blacklist[{index}]"] = [str(key) for key in blacklist.tripped_keys()]
+            open_keys = [str(key) for key in blacklist.tripped_keys()]
+            # a breaker held open against a peer we crash-killed (and whose old
+            # identity never came back) is the breaker WORKING, not a failure
+            open_keys = [key for key in open_keys if key not in dead_peer_ids]
+            tripped[f"dht_blacklist[{index}]"] = open_keys
         tripped["moe_expert"] = [str(key) for key in EXPERT_BREAKERS.tripped_keys()]
 
         total_injections = sum(report.get("chaos_stats", {}).values())
@@ -260,6 +470,23 @@ def run_soak(
             index: step_counts[index] - steps_at_chaos_end.get(index, 0) for index in step_counts
         }
 
+        restarted = {index: slot for index, slot in slots.items() if slot.restarts > 0}
+        restart_report = {}
+        for index, slot in restarted.items():
+            # read the LIVE optimizer, not the per-step snapshot: a peer deep in
+            # a slow averaging round has advanced past its last-reported epoch
+            local_epoch = slot.opt.local_epoch if slot.opt is not None else 0
+            restart_report[index] = {
+                "restarts": slot.restarts,
+                "final_epoch": local_epoch,
+                "global_epoch": final_global_epoch,
+                # one-epoch grace is inherent to the protocol: a peer at
+                # global-1 transitions itself on its next ready step
+                "recovered": local_epoch >= final_global_epoch - 1 and local_epoch > 0,
+            }
+        digest_failures = _STATE_SYNC_DIGEST_FAILURES.value(site="download") - digest_failures_before
+        digest_failures_adopted = _STATE_SYNC_UNVERIFIED.value() - unverified_before
+
         report.update(
             steps=dict(step_counts),
             steps_after_chaos=steps_after_chaos,
@@ -268,6 +495,10 @@ def run_soak(
             breakers_still_tripped={name: keys for name, keys in tripped.items() if keys},
             missed_points=missed_points,
             total_injections=total_injections,
+            digest_failures=digest_failures,
+            digest_failures_adopted=digest_failures_adopted,
+            restarts=restart_report,
+            state_recovered=all(entry["recovered"] for entry in restart_report.values()),
             errors=errors,
         )
 
@@ -280,10 +511,16 @@ def run_soak(
             # the loop between the chaos engine and the flight recorder: at
             # least one injected fault must be visible as a span event
             "chaos_visible_in_trace": report.get("chaos_span_events", 0) >= 1,
+            # corrupted payloads may be REJECTED (digest_failures > 0 is
+            # expected under the corrupt_payload rule) but never ADOPTED
+            "digest_failures_adopted_zero": digest_failures_adopted == 0,
             "no_thread_errors": not errors,
         }
         if include_moe:
             checks["moe_recovered"] = moe_stats["ok_after"] > 0
+        if churn:
+            checks["peers_restarted"] = bool(restart_report)
+            checks["state_recovered"] = bool(report["state_recovered"]) and bool(restart_report)
         report["checks"] = checks
         report["ok"] = all(checks.values())
         return report
@@ -294,6 +531,8 @@ def run_soak(
         CHAOS.clear()
         EXPERT_BREAKERS.reconfigure(recovery_time=original_expert_recovery)
         reset_all_boards()
+        if checkpoint_dir_ctx is not None:
+            checkpoint_dir_ctx.cleanup()
 
 
 def main() -> None:
@@ -304,12 +543,20 @@ def main() -> None:
     parser.add_argument("--chaos-fraction", type=float, default=0.6,
                         help="fraction of the soak spent with faults armed")
     parser.add_argument("--no-moe", action="store_true", help="skip the MoE server/client pair")
+    parser.add_argument("--churn", action="store_true",
+                        help="crash-kill and restart peers on a seeded schedule (ISSUE 7); "
+                             "the verdict then requires state_recovered and zero unverified adoptions")
+    parser.add_argument("--churn-kills", type=int, default=None,
+                        help="how many kill/restart cycles (default: peers // 3, min 1)")
+    parser.add_argument("--checkpoint-root", default=None,
+                        help="directory for per-peer crash-safe checkpoints (default: a tempdir)")
     parser.add_argument("--spec", default=None,
                         help="HIVEMIND_CHAOS-grammar schedule overriding the default")
     args = parser.parse_args()
     report = run_soak(
         n_peers=args.peers, duration=args.duration, seed=args.seed,
         chaos_fraction=args.chaos_fraction, include_moe=not args.no_moe, spec=args.spec,
+        churn=args.churn, churn_kills=args.churn_kills, checkpoint_root=args.checkpoint_root,
     )
     print(json.dumps(report, indent=2, default=str))
     sys.exit(0 if report["ok"] else 1)

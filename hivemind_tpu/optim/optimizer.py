@@ -31,12 +31,22 @@ from hivemind_tpu.dht import DHT
 from hivemind_tpu.optim.chronic import ChronicFailureTracking
 from hivemind_tpu.optim.grad_averager import GradientAverager
 from hivemind_tpu.optim.progress_tracker import ProgressTracker
+from hivemind_tpu.optim.recovery import LocalCheckpointStore, restore_from_local
 from hivemind_tpu.optim.state_averager import TrainingStateAverager
+from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
 from hivemind_tpu.telemetry.tracing import trace as _tracing_span
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.timed_storage import get_dht_time
 
 logger = get_logger(__name__)
+
+# ISSUE 7 satellite: a peer that cannot download state adopts the global epoch
+# NUMBER while skipping the training that produced it — silently, this turns a
+# flaky download path into quiet model divergence; counted so the monitor sees it
+_EPOCH_ADOPTED_WITHOUT_STATE = _TELEMETRY.counter(
+    "hivemind_optimizer_epoch_adopted_without_state_total",
+    "epoch fast-forwards after a failed state download (epoch number adopted, state NOT)",
+)
 
 
 class Optimizer(ChronicFailureTracking):
@@ -68,6 +78,13 @@ class Optimizer(ChronicFailureTracking):
     :param delta_rule_averaging: apply state-averaging results as deltas so optimizer
         steps running concurrently with the round survive (required for DPU/local
         updates; reference state_averager.py:73-74)
+    :param checkpoint_dir: when set (non-auxiliary peers), keep crash-safe local
+        checkpoints there: atomically-published, digest-stamped snapshots saved on
+        an epoch cadence and restored at startup, so a machine reboot costs a file
+        read instead of a swarm download (restore order: local-verified → swarm →
+        fresh; docs/state_recovery.md)
+    :param checkpoint_every: save every N epochs (default 1)
+    :param checkpoint_keep_last: checkpoints retained after every save (default 3)
     """
 
     def __init__(
@@ -100,6 +117,9 @@ class Optimizer(ChronicFailureTracking):
         tracker_opts: Optional[dict] = None,
         shutdown_timeout: float = 5.0,
         chronic_failure_threshold: int = 5,
+        checkpoint_dir: Optional[Any] = None,
+        checkpoint_every: int = 1,
+        checkpoint_keep_last: int = 3,
         verbose: bool = False,
     ):
         assert not (client_mode and auxiliary), "a peer is either a client or an auxiliary, not both"
@@ -162,6 +182,27 @@ class Optimizer(ChronicFailureTracking):
                 **averager_common,
                 **state_opts,
             )
+        # crash-safe recovery (ISSUE 7): restore order is local-verified
+        # checkpoint → swarm download (the catch-up path, triggered by the
+        # tracker if the checkpoint is stale) → fresh initialization
+        self.checkpoint_store: Optional[LocalCheckpointStore] = None
+        self.checkpoint_every = max(1, int(checkpoint_every))
+        self._checkpoint_executor: Optional[ThreadPoolExecutor] = None
+        self._pending_checkpoint: Optional[Future] = None
+        if checkpoint_dir is not None and not auxiliary:
+            self.checkpoint_store = LocalCheckpointStore(
+                checkpoint_dir, keep_last=checkpoint_keep_last
+            )
+            # serialize+fsync runs off the training thread; the state SNAPSHOT
+            # is still taken synchronously so it is epoch-consistent
+            self._checkpoint_executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="hm_ckpt"
+            )
+            restored_epoch = restore_from_local(self.state_averager, self.checkpoint_store)
+            if restored_epoch is not None:
+                # donors are ranked by sharing priority = epoch: a restored peer
+                # should advertise what it actually holds
+                self.state_averager.state_sharing_priority = restored_epoch
         self.grad_averager: Optional[GradientAverager] = None
         if not use_local_updates:
             tensors_like = (
@@ -275,6 +316,7 @@ class Optimizer(ChronicFailureTracking):
                         timeout=self.averaging_timeout,
                         scheduled_time=get_dht_time() + self._matchmaking_delay(),
                     )
+            self._maybe_save_checkpoint(self.local_epoch)
             self.tracker.update_epoch(self.local_epoch)
         return self.state_averager.params
 
@@ -360,6 +402,9 @@ class Optimizer(ChronicFailureTracking):
                 scheduled_time=get_dht_time() + self._matchmaking_delay(),
             )
         self.state_averager.state_sharing_priority = next_epoch
+        # checkpoint AFTER the state-averaging round so the file holds the
+        # swarm-averaged tensors this epoch actually produced
+        self._maybe_save_checkpoint(next_epoch)
         self.tracker.update_epoch(next_epoch)
         if self.verbose:
             logger.info(
@@ -445,16 +490,89 @@ class Optimizer(ChronicFailureTracking):
         """We are behind the swarm: adopt a peer's state
         (reference _should_load_state_from_peers + load_state_from_peers)."""
         assert self.state_averager is not None
+        global_epoch = self.tracker.global_epoch
         logger.info(
-            f"local epoch {self.local_epoch} is behind the swarm ({self.tracker.global_epoch}); "
+            f"local epoch {self.local_epoch} is behind the swarm ({global_epoch}); "
             f"downloading state"
         )
-        if self.state_averager.load_full_state_from_peers(timeout=self.load_state_timeout):
+        # min_epoch: donors serving state older than the tracker's published
+        # progress are rejected at their manifest, never adopted (ISSUE 7). The
+        # one-epoch grace mirrors the protocol's own transition asynchrony: the
+        # peer whose report SET global_epoch may have crashed, leaving every
+        # live donor one epoch behind — adopting global-1 still lands us in the
+        # normal grace band (we transition ourselves next ready step), whereas
+        # zero grace would reject the whole swarm and fast-forward with STALE
+        # local params, which is strictly worse
+        if self.state_averager.load_full_state_from_peers(
+            timeout=self.load_state_timeout, min_epoch=max(0, global_epoch - 1)
+        ):
             if self.grad_averager is not None:
                 self.grad_averager.reset_accumulated_grads_()
+            # a crash right after catch-up should not redo the download
+            self._maybe_save_checkpoint(self.local_epoch, force=True)
         else:
-            # could not download: adopt the epoch number to avoid re-triggering forever
+            # could not download: adopt the epoch NUMBER to avoid re-triggering
+            # forever — but this peer now claims training it never did, so say
+            # it loudly and count it (ISSUE 7 satellite): chronic occurrences
+            # mean the swarm's recovery path is broken, not merely flaky
+            _EPOCH_ADOPTED_WITHOUT_STATE.inc()
+            logger.error(
+                f"state download failed; fast-forwarding local epoch "
+                f"{self.local_epoch} -> {self.tracker.global_epoch} WITHOUT adopting state "
+                f"(parameters keep their pre-catch-up values)"
+            )
             self.state_averager.local_epoch = self.tracker.global_epoch
+
+    def _maybe_save_checkpoint(self, epoch: int, force: bool = False) -> None:
+        """Publish a local checkpoint on the configured epoch cadence (crash-safe:
+        recovery.LocalCheckpointStore). The epoch-consistent snapshot is captured
+        here; serialize+write+fsync runs on the checkpoint executor so the
+        training step is never blocked on disk (``force`` — shutdown / just after
+        a catch-up — saves synchronously for durability). A save still in flight
+        when the next cadence hits is not queued behind: that epoch is skipped.
+        Failures never fail the step — a peer with a broken disk keeps training,
+        loudly."""
+        if self.checkpoint_store is None or self.state_averager is None:
+            return
+        if not force and epoch % self.checkpoint_every != 0:
+            return
+        if self._pending_checkpoint is not None and self._pending_checkpoint.done():
+            pending, self._pending_checkpoint = self._pending_checkpoint, None
+            try:
+                pending.result(0)
+            except Exception as e:
+                logger.warning(f"background checkpoint save failed: {e!r}")
+        if not force and self._pending_checkpoint is not None:
+            # decided BEFORE the snapshot: copying the full state just to throw
+            # it away would hold the state lock on the training thread for nothing
+            logger.debug(f"checkpoint save at epoch {epoch} skipped: previous save in flight")
+            return
+        if force and self._pending_checkpoint is not None:
+            # a forced save must not run concurrently with the background writer:
+            # two interleaved save()/prune() passes could sweep each other's
+            # temp files, and the forced save must end up the durable one
+            pending, self._pending_checkpoint = self._pending_checkpoint, None
+            try:
+                pending.result(60)
+            except Exception as e:
+                logger.warning(f"background checkpoint save failed: {e!r}")
+        try:
+            state = self.state_averager.state_dict()
+        except Exception as e:
+            logger.warning(f"checkpoint snapshot at epoch {epoch} failed: {e!r}")
+            return
+
+        def _write() -> None:
+            with _tracing_span("state_sync.checkpoint", epoch=epoch):
+                self.checkpoint_store.save(state)
+
+        if force or self._checkpoint_executor is None:
+            try:
+                _write()
+            except Exception as e:
+                logger.warning(f"checkpoint save at epoch {epoch} failed: {e!r}")
+        else:
+            self._pending_checkpoint = self._checkpoint_executor.submit(_write)
 
     @staticmethod
     def _bootstrap_grad_schema(dht: DHT, prefix: str, timeout: Optional[float]):
@@ -505,6 +623,13 @@ class Optimizer(ChronicFailureTracking):
             self._finish_pending_update(timeout=self.averaging_timeout)
         if self._update_executor is not None:
             self._update_executor.shutdown(wait=True)
+        # final checkpoint: a clean shutdown restores exactly where it stopped
+        # (drain the background writer first so the forced save is the newest)
+        if self._checkpoint_executor is not None:
+            self._checkpoint_executor.shutdown(wait=True)
+            self._pending_checkpoint = None
+            self._checkpoint_executor = None
+        self._maybe_save_checkpoint(self.local_epoch, force=True)
         self.tracker.shutdown()
         if self.scheduled_grads is not None:
             self.scheduled_grads.cancel()

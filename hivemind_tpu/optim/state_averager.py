@@ -19,6 +19,7 @@ import numpy as np
 from hivemind_tpu.averaging.averager import DecentralizedAverager
 from hivemind_tpu.compression.base import as_numpy
 from hivemind_tpu.dht import DHT
+from hivemind_tpu.optim.recovery import _STATE_RESTORES
 from hivemind_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -274,22 +275,46 @@ class TrainingStateAverager(DecentralizedAverager):
         metadata = {"epoch": self.local_epoch}
         return metadata, self._host_state_tensors()
 
-    def load_full_state_from_peers(self, timeout: Optional[float] = None) -> bool:
-        """Download params/opt-state/epoch from the best peer and adopt them
-        (reference load_state_from_peers path, state_averager.py:658-698)."""
-        result = self.load_state_from_peers(timeout=timeout)
+    def load_full_state_from_peers(
+        self, timeout: Optional[float] = None, min_epoch: Optional[int] = None
+    ) -> bool:
+        """Download params/opt-state/epoch from the swarm and adopt them
+        (reference load_state_from_peers path, state_averager.py:658-698).
+
+        ``min_epoch`` (normally the progress tracker's global epoch) is enforced
+        at the donor's MANIFEST: a donor whose epoch is behind it is rejected
+        before any tensor bytes move, so catching up can never adopt state staler
+        than the swarm's published progress (ISSUE 7 — the old path adopted any
+        donor's epoch via ``max()`` with no freshness validation)."""
+        future = self._runner.run_coroutine(
+            self._load_state_from_peers_async(timeout, min_epoch=min_epoch), return_future=True
+        )
+        try:
+            # small slack over the coroutine's own deadline so the in-loop
+            # timeout (which preserves partial verification state) fires first
+            result = future.result(None if timeout is None else timeout + 10.0)
+        except Exception as e:
+            logger.warning(f"state download did not complete: {e!r}")
+            return False
         if result is None:
             return False
-        metadata, tensors = result
         expected = len(self._params_flat) + len(self._averaged_opt_indices) + len(self.extra_tensors)
-        if len(tensors) != expected:
-            logger.warning(f"donor sent {len(tensors)} tensors, expected {expected}; ignoring")
+        if len(result.tensors) != expected:
+            logger.warning(f"donor sent {len(result.tensors)} tensors, expected {expected}; ignoring")
             return False
-        self._load_host_state_tensors(tensors)
-        if isinstance(metadata, dict) and "epoch" in metadata:
-            self.local_epoch = max(self.local_epoch, int(metadata["epoch"]))
+        self._load_host_state_tensors(result.tensors)
+        # the verified manifest's epoch is authoritative; a legacy (unverified)
+        # stream falls back to the msgpack metadata it shipped
+        donor_epoch = int(result.epoch)
+        if not result.verified and isinstance(result.metadata, dict) and "epoch" in result.metadata:
+            donor_epoch = max(donor_epoch, int(result.metadata["epoch"]))
+        self.local_epoch = max(self.local_epoch, donor_epoch)
         # int step counters are not averaged tensors: fast-forward them so LR
         # schedules resume at the adopted epoch rather than restarting warmup
         self.replay_schedule_to_epoch(self.local_epoch)
-        logger.info(f"adopted peer state at epoch {self.local_epoch}")
+        _STATE_RESTORES.inc(source="swarm")
+        logger.info(
+            f"adopted peer state at epoch {self.local_epoch} "
+            f"({'digest-verified' if result.verified else 'UNVERIFIED legacy stream'})"
+        )
         return True

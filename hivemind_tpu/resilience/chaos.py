@@ -24,6 +24,8 @@ point                       where it fires
 ``allreduce.reduce``        reducer side, per delta returned to a sender
 ``moe.forward``             per expert forward RPC (scope = expert uid)
 ``moe.backward``            per expert backward RPC (scope = expert uid)
+``state.download.send``     donor side, per state-sync message (scope = donor id)
+``state.download.recv``     receiver side, per state-sync message (scope = donor id)
 ==========================  ====================================================
 
 Actions: ``drop`` (raises :class:`ChaosDrop`, a ``ConnectionError`` — looks
@@ -68,6 +70,7 @@ INJECTION_POINTS = (
     "dht.rpc_ping", "dht.rpc_store", "dht.rpc_find",
     "allreduce.setup", "allreduce.load", "allreduce.reduce",
     "moe.forward", "moe.backward",
+    "state.download.send", "state.download.recv",
 )
 
 ACTIONS = ("drop", "delay", "abort", "corrupt_payload")

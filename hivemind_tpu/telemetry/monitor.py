@@ -270,6 +270,16 @@ class SwarmMonitor:
             if "min" in agg and agg.get("min") != agg.get("max"):
                 extra += f", min={agg['min']}, max={agg['max']}"
             lines.append(f"  {name} [{agg['type']}] total={agg['total']}{extra} ({agg['peers']} peers)")
+        # recovery-path emergencies (docs/state_recovery.md): either of these
+        # growing means the swarm is quietly diverging — a peer claimed epochs
+        # it never trained, or adopted state no digest ever blessed
+        for name, what in (
+            ("hivemind_optimizer_epoch_adopted_without_state_total", "epoch(s) adopted WITHOUT state"),
+            ("hivemind_state_sync_unverified_adoptions_total", "unverified (manifest-less) state adoption(s)"),
+        ):
+            agg = view.get("metrics", {}).get(name)
+            if agg and agg.get("total"):
+                lines.append(f"  RECOVERY ALERT: {agg['total']:g} {what} across the swarm")
         for peer, health in sorted(view.get("peers", {}).items()):
             breakers = health.get("breakers") or {}
             slow = health.get("slow_spans") or []

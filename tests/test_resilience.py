@@ -366,6 +366,27 @@ def test_chaos_soak_smoke():
     assert report["checks"]["no_thread_errors"], report
 
 
+@pytest.mark.chaos
+def test_churn_soak_smoke():
+    """Tier-1-safe churn soak (ISSUE 7, ~35 s): 3 trainers under the default
+    fault schedule (including state.download corruption/drops), one crash-killed
+    mid-chaos — DHT yanked, no shutdown — and restarted against its crash-safe
+    checkpoint directory. The verdict requires every restarted peer back at the
+    tracker's global epoch and ZERO unverified/corrupt state adoptions."""
+    from hivemind_tpu.hivemind_cli.run_chaos_soak import run_soak
+
+    report = run_soak(
+        n_peers=3, duration=32.0, seed=0, chaos_fraction=0.5,
+        include_moe=False, churn=True, churn_kills=1,
+    )
+    assert report["checks"]["peers_restarted"], report
+    assert report["checks"]["state_recovered"], report
+    assert report["digest_failures_adopted"] == 0, report
+    assert report["checks"]["digest_failures_adopted_zero"], report
+    assert report["checks"]["steps_advanced_after_chaos"], report
+    assert report["checks"]["no_thread_errors"], report
+
+
 @pytest.mark.slow
 @pytest.mark.chaos
 def test_chaos_soak_full():
@@ -375,6 +396,20 @@ def test_chaos_soak_full():
     from hivemind_tpu.hivemind_cli.run_chaos_soak import run_soak
 
     report = run_soak(n_peers=4, duration=60.0, seed=0, chaos_fraction=0.6, include_moe=True)
+    assert report["ok"], report
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_soak_full_churn():
+    """The ISSUE 7 acceptance soak: chaos + seeded churn; the full verdict
+    (including state_recovered and digest_failures_adopted: 0) must hold
+    (also runnable as ``python -m hivemind_tpu.hivemind_cli.run_chaos_soak --churn``)."""
+    from hivemind_tpu.hivemind_cli.run_chaos_soak import run_soak
+
+    report = run_soak(
+        n_peers=4, duration=60.0, seed=0, chaos_fraction=0.6, include_moe=True, churn=True,
+    )
     assert report["ok"], report
 
 
