@@ -422,16 +422,26 @@ def telemetry_section(averaging=None) -> dict:
     """The telemetry snapshot embedded in every BENCH artifact (ISSUE 2): the
     bench process's own registry plus the averaging swarm's snapshot (shipped
     through the subprocess's JSON extra), so round artifacts carry a per-phase
-    breakdown — five rounds of BENCH carried none (VERDICT r5)."""
+    breakdown — five rounds of BENCH carried none (VERDICT r5).
+
+    ISSUE 8: the averaging swarm's ledger + watchdog summary ride along
+    (``attribution`` key) — rounds run, mean/p95 per-phase durations, straggler
+    scores, stall count and max loop lag — so a perf regression's artifact says
+    WHERE the regression lives (matchmaking? one slow peer? a blocked loop?),
+    not just the headline number."""
     try:
         from hivemind_tpu.telemetry import build_peer_snapshot
 
         section: dict = {"bench_process": build_peer_snapshot()}
     except Exception as e:  # the artifact must survive a broken local install
         section = {"error": repr(e)[:200]}
-    swarm = ((averaging or {}).get("extra") or {}).get("telemetry")
+    averaging_extra = (averaging or {}).get("extra") or {}
+    swarm = averaging_extra.get("telemetry")
     if swarm:
         section["averaging_swarm"] = swarm
+    attribution = averaging_extra.get("attribution")
+    if attribution:
+        section["attribution"] = attribution
     return section
 
 
@@ -471,11 +481,14 @@ def main() -> None:
 
     result.setdefault("extra", {})
     result["extra"]["averaging_gbps_per_peer"] = (averaging or {}).get("value")
-    # the swarm telemetry snapshot lands ONCE, in result["telemetry"] below —
-    # strip it from the copied extra so the artifact does not carry it twice
+    # the swarm telemetry + attribution snapshots land ONCE, in
+    # result["telemetry"] below — strip them from the copied extra so the
+    # artifact does not carry them twice
     averaging_extra = (averaging or {}).get("extra")
     if isinstance(averaging_extra, dict):
-        averaging_extra = {k: v for k, v in averaging_extra.items() if k != "telemetry"}
+        averaging_extra = {
+            k: v for k, v in averaging_extra.items() if k not in ("telemetry", "attribution")
+        }
     result["extra"]["averaging_extra"] = averaging_extra
     # attributability: the same-config controls bracket the averaging run, so a
     # co-tenancy swing shows up as a control swing right next to the number

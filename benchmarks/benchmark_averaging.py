@@ -46,7 +46,7 @@ def main():
     from hivemind_tpu.averaging import DecentralizedAverager
     from hivemind_tpu.compression import CompressionType, get_codec
     from hivemind_tpu.dht import DHT
-    from hivemind_tpu.telemetry import REGISTRY
+    from hivemind_tpu.telemetry import LEDGER, REGISTRY, watchdog_summary
 
     first = DHT(start=True)
     maddrs = [str(m) for m in first.get_visible_maddrs()]
@@ -96,6 +96,10 @@ def main():
             # swarm: embed it so BENCH artifacts carry the per-phase breakdown
             # (VERDICT r5: five rounds of artifacts had none)
             "telemetry": REGISTRY.snapshot(),
+            # per-round attribution (ISSUE 8): rounds, mean/p95 phase durations
+            # and straggler scores from the ledger, plus event-loop stall count
+            # and max lag — a regressed headline number then names its cause
+            "attribution": {"ledger": LEDGER.summary(), "watchdog": watchdog_summary()},
         },
     }))
     for averager in averagers:

@@ -160,6 +160,12 @@ class DecentralizedAverager(ServicerBase):
     async def _setup(self) -> None:
         if self._ready.is_set():
             return
+        # the shared loop carries every RPC/matchmaking/allreduce await of this
+        # peer: arm the stall watchdog before any of them can run (idempotent —
+        # the DHT usually armed it already)
+        from hivemind_tpu.telemetry.watchdog import ensure_watchdog
+
+        ensure_watchdog(asyncio.get_event_loop())
         self.p2p: P2P = await self.dht.replicate_p2p()
         self.peer_id: PeerID = self.p2p.peer_id
         self._allreduce_registered = asyncio.Condition()

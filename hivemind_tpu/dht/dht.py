@@ -65,6 +65,11 @@ class DHT:
     async def _create_node(self) -> None:
         if self._node is not None:
             return
+        # a blocked event loop makes this peer look like a network straggler to
+        # the whole swarm: watch for stalls from the moment the node exists
+        from hivemind_tpu.telemetry.watchdog import ensure_watchdog
+
+        ensure_watchdog(asyncio.get_event_loop())
         self._node = await DHTNode.create(
             p2p=self._p2p_arg,
             initial_peers=self.initial_peers,

@@ -6,6 +6,9 @@ point, then the faults stop and the soak asserts the swarm LIVED through it:
 - the MoE client keeps getting expert responses after the faults stop,
 - every circuit breaker tripped during the storm returns to closed,
 - every named injection point actually saw traffic,
+- the round ledger NAMED at least one straggler during the chaos-delay phase
+  (ISSUE 8: injected slowness must be attributable, not just survivable), and
+  the event-loop watchdog counted zero stalls once the faults were disarmed,
 - with ``--churn``: peers are crash-killed on a seeded schedule (their DHT
   yanked mid-round, no shutdown, state declarations left dangling) and
   restarted with a local checkpoint directory — the verdict then requires
@@ -35,7 +38,10 @@ import time
 from typing import Dict, List, Optional
 
 from hivemind_tpu.resilience import CHAOS, INJECTION_POINTS, reset_all_boards
+from hivemind_tpu.telemetry import REGISTRY
+from hivemind_tpu.telemetry.ledger import LEDGER
 from hivemind_tpu.telemetry.tracing import RECORDER
+from hivemind_tpu.telemetry.watchdog import watchdog_summary
 from hivemind_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -128,6 +134,13 @@ def run_soak(
     # span event found at verdict time was injected by this run (ISSUE 4: the
     # chaos engine and the tracer must provably connect)
     RECORDER.clear()
+    # same for the round ledger (ISSUE 8): every record + straggler attribution
+    # found at verdict time was produced under this soak's rounds
+    LEDGER.clear()
+
+    def _total_watchdog_stalls() -> float:
+        metric = REGISTRY.get("hivemind_event_loop_stalls_total")
+        return sum(child.value for _key, child in metric.series()) if metric is not None else 0.0
     digest_failures_before = _STATE_SYNC_DIGEST_FAILURES.value(site="download")
     unverified_before = _STATE_SYNC_UNVERIFIED.value()
     # the soak's recovery window is short: expert breakers must be probeable
@@ -391,8 +404,27 @@ def run_soak(
                 for span in RECORDER.snapshot()
             )
             report["chaos_span_events"] = chaos_span_events
+            # ledger verdict inputs, read NOW while every record is chaos-era
+            # (ISSUE 8): the chaos-delay schedule must have produced at least
+            # one straggler attribution — a partner named slowest in a record
+            # AND actually slow. The slowness floor keeps the check from being
+            # vacuous: every round with a remote exchange names SOME slowest
+            # peer, so bare existence would pass even with no delay rule armed.
+            # 0.1 s is the smallest delay in DEFAULT_SCHEDULE, ~2x a healthy
+            # toy-round exchange on this swarm.
+            chaos_ledger_records = LEDGER.records()
+            report["ledger_rounds_under_chaos"] = len(chaos_ledger_records)
+            straggler_floor_s = 0.1
+            report["straggler_attributions_under_chaos"] = sum(
+                1 for record in chaos_ledger_records
+                if record.get("slowest_peer")
+                and float(record.get("slowest_s", 0.0)) >= straggler_floor_s
+            )
             CHAOS.clear()
             chaos_off_event.set()
+            # the disarmed-phase watchdog baseline: any stall counted from here
+            # on happened with NO faults armed — a real bug, not injected noise
+            stalls_at_disarm = _total_watchdog_stalls()
             logger.warning("chaos window over: faults disarmed, watching recovery")
 
             # phase 2: recovery. The base window is fixed; with churn, a BOUNDED
@@ -486,6 +518,10 @@ def run_soak(
             }
         digest_failures = _STATE_SYNC_DIGEST_FAILURES.value(site="download") - digest_failures_before
         digest_failures_adopted = _STATE_SYNC_UNVERIFIED.value() - unverified_before
+        stalls_while_disarmed = _total_watchdog_stalls() - stalls_at_disarm
+        report["watchdog"] = watchdog_summary()
+        report["watchdog_stalls_while_disarmed"] = stalls_while_disarmed
+        report["ledger_summary"] = LEDGER.summary()
 
         report.update(
             steps=dict(step_counts),
@@ -514,6 +550,12 @@ def run_soak(
             # corrupted payloads may be REJECTED (digest_failures > 0 is
             # expected under the corrupt_payload rule) but never ADOPTED
             "digest_failures_adopted_zero": digest_failures_adopted == 0,
+            # attribution verdict (ISSUE 8): the chaos-delay phase must have
+            # NAMED a slow partner in the round ledger...
+            "straggler_attributed": report["straggler_attributions_under_chaos"] >= 1,
+            # ...and a healthy, undisturbed swarm must not stall its loops —
+            # a disarmed-phase stall is a real blocking bug the faults masked
+            "watchdog_stalls_zero_disarmed": stalls_while_disarmed == 0,
             "no_thread_errors": not errors,
         }
         if include_moe:

@@ -54,6 +54,14 @@ def main():
         logger.info(f"listening: {maddr}")
     logger.info(f"to join this swarm: --initial_peers {dht.get_visible_maddrs()[0]}")
 
+    # the DHT armed the event-loop watchdog on its loop; asserting here keeps
+    # the CLI loud if the kill switch (HIVEMIND_WATCHDOG=0) disabled it
+    from hivemind_tpu.telemetry import ensure_watchdog
+    from hivemind_tpu.utils.loop import get_loop_runner
+
+    if ensure_watchdog(get_loop_runner().loop) is None:
+        logger.warning("event-loop watchdog disabled (HIVEMIND_WATCHDOG=0): stalls will be silent")
+
     exporter = publisher = None
     if args.metrics_port is not None:
         from hivemind_tpu.telemetry import MetricsExporter

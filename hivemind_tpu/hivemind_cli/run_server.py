@@ -218,6 +218,12 @@ def _serve_llama_checkpoint(args) -> Server:
 def _start_telemetry(args, dht):
     """Optional metrics endpoint + DHT snapshot publisher (docs/observability.md);
     returns the components to shut down, or an empty tuple."""
+    from hivemind_tpu.telemetry import ensure_watchdog
+    from hivemind_tpu.utils.loop import get_loop_runner
+
+    # server + DHT already armed the loop watchdog; stay loud if it is disabled
+    if ensure_watchdog(get_loop_runner().loop) is None:
+        logger.warning("event-loop watchdog disabled (HIVEMIND_WATCHDOG=0): stalls will be silent")
     components = []
     if args.metrics_port is not None:
         from hivemind_tpu.telemetry import MetricsExporter

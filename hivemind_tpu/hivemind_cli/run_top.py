@@ -1,0 +1,259 @@
+"""``hivemind-top``: a live terminal dashboard over the swarm's telemetry
+(ISSUE 8 tentpole). One screen, refreshed in place, answering the operator's
+standing questions without Prometheus or Perfetto:
+
+- **per-peer vitals** — epoch, samples/s (frame-to-frame delta), event-loop
+  lag and stall count, tripped breakers, snapshot age (peers whose snapshot
+  age exceeds 3x the publish interval are flagged ``STALE``);
+- **straggler table** — per-peer straggler scores merged across every peer's
+  round ledger: which partner was slowest, how often, and how many excess
+  seconds it cost the swarm;
+- **recent alerts** — watchdog stalls (with the blocking frame), recovery
+  emergencies, slow spans, degraded rounds.
+
+Everything renders from the DHT-published snapshots (`--key` must match the
+swarm's ``TelemetryPublisher`` key), so the dashboard is a pure *reader*: it
+joins the DHT, polls, and draws — it cannot perturb the run it watches.
+
+Run it::
+
+    hivemind-top --initial_peers /ip4/.../tcp/.../p2p/... --key myrun_telemetry
+
+``--frames 1 --no-ansi`` renders one plain frame and exits (scripts, tests).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from hivemind_tpu.telemetry.monitor import DEFAULT_PUBLISH_INTERVAL, STALE_AFTER_FACTOR
+from hivemind_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_CLEAR = "\x1b[2J\x1b[H"
+_BOLD, _RED, _YELLOW, _DIM, _RESET = "\x1b[1m", "\x1b[31m", "\x1b[33m", "\x1b[2m", "\x1b[0m"
+
+
+def _metric_total(snapshot: Dict[str, Any], name: str, field: str = "count") -> Optional[float]:
+    """Sum of one metric family's series in a peer snapshot (gauges/counters sum
+    their values; histograms sum ``field`` — 'count' or 'sum')."""
+    family = (snapshot.get("metrics") or {}).get(name)
+    if not isinstance(family, dict):
+        return None
+    total = 0.0
+    for value in (family.get("series") or {}).values():
+        if isinstance(value, dict):
+            total += float(value.get(field, 0.0))
+        else:
+            total += float(value)
+    return total
+
+
+def _loop_lag_ms(snapshot: Dict[str, Any]) -> Optional[float]:
+    count = _metric_total(snapshot, "hivemind_event_loop_lag_seconds", "count")
+    total = _metric_total(snapshot, "hivemind_event_loop_lag_seconds", "sum")
+    if not count:
+        return None
+    return (total or 0.0) / count * 1e3
+
+
+def render_frame(
+    records: Dict[str, Dict[str, Any]],
+    *,
+    publish_interval: float = DEFAULT_PUBLISH_INTERVAL,
+    prev_samples: Optional[Dict[str, Tuple[float, float]]] = None,
+    now: Optional[float] = None,
+    ansi: bool = True,
+) -> Tuple[str, Dict[str, Tuple[float, float]]]:
+    """One dashboard frame from the swarm's snapshots. Pure: no DHT, no IO.
+
+    ``prev_samples`` maps peer -> (samples_gauge, frame_time) from the previous
+    frame; returns the updated map so the caller can thread it through for the
+    samples/s column. Plain text with ``ansi=False`` (tests, piping)."""
+    now = now if now is not None else time.time()
+    bold = _BOLD if ansi else ""
+    red = _RED if ansi else ""
+    yellow = _YELLOW if ansi else ""
+    dim = _DIM if ansi else ""
+    reset = _RESET if ansi else ""
+    samples_state: Dict[str, Tuple[float, float]] = {}
+    stale_after = STALE_AFTER_FACTOR * publish_interval
+
+    lines: List[str] = []
+    lines.append(
+        f"{bold}hivemind-top{reset} — {len(records)} peer(s), "
+        f"{time.strftime('%H:%M:%S', time.localtime(now))} "
+        f"{dim}(snapshot age > {stale_after:.0f}s = STALE){reset}"
+    )
+    header = (
+        f"{'peer':<18} {'age':>5} {'epoch':>6} {'smp/s':>8} {'lag ms':>7} "
+        f"{'stalls':>6} {'brk':>4} {'rounds':>6}  flags"
+    )
+    lines.append(bold + header + reset)
+
+    alerts: List[str] = []
+    straggler_board: Dict[str, Dict[str, float]] = {}
+
+    def _render_peer(peer: str, snapshot: Dict[str, Any]) -> None:
+        age = max(now - float(snapshot.get("time", now)), 0.0)
+        epoch = _metric_total(snapshot, "hivemind_optim_local_epoch")
+        samples = _metric_total(snapshot, "hivemind_optim_local_samples_accumulated")
+        rate = None
+        if samples is not None:
+            samples_state[peer] = (samples, now)
+            if prev_samples and peer in prev_samples:
+                prev_value, prev_time = prev_samples[peer]
+                if now > prev_time:
+                    # accumulators reset each epoch: a negative delta is an
+                    # epoch boundary, not negative throughput
+                    rate = max(samples - prev_value, 0.0) / (now - prev_time)
+        lag_ms = _loop_lag_ms(snapshot)
+        watchdog = snapshot.get("watchdog") or {}
+        stalls = int(watchdog.get("stalls", _metric_total(snapshot, "hivemind_event_loop_stalls_total") or 0))
+        breakers = snapshot.get("breakers") or {}
+        num_tripped = sum(int(b.get("num_tripped", 0)) for b in breakers.values() if isinstance(b, dict))
+        ledger = snapshot.get("ledger") or {}
+        rounds = len(ledger.get("records") or ())
+
+        flags: List[str] = []
+        if age > stale_after:
+            flags.append(f"{red}STALE{reset}")
+        if stalls:
+            flags.append(f"{red}LOOP-STALLED{reset}")
+        if num_tripped:
+            flags.append(f"{yellow}BREAKERS{reset}")
+        if snapshot.get("slow_spans"):
+            flags.append(f"{yellow}SLOW-SPANS{reset}")
+        if snapshot.get("truncated"):
+            flags.append(f"{dim}truncated{reset}")
+
+        lines.append(
+            f"{peer[:18]:<18} {age:>4.0f}s "
+            f"{(f'{epoch:.0f}' if epoch is not None else '-'):>6} "
+            f"{(f'{rate:.1f}' if rate is not None else '-'):>8} "
+            f"{(f'{lag_ms:.2f}' if lag_ms is not None else '-'):>7} "
+            f"{stalls:>6} {num_tripped:>4} {rounds:>6}  {' '.join(flags)}"
+        )
+
+        for victim, score in (ledger.get("stragglers") or {}).items():
+            board = straggler_board.setdefault(
+                str(victim), {"rounds_slowest": 0, "excess_s": 0.0, "reporters": 0}
+            )
+            board["rounds_slowest"] += int(score.get("rounds_slowest", 0))
+            board["excess_s"] = round(board["excess_s"] + float(score.get("excess_s", 0.0)), 3)
+            board["reporters"] += 1
+
+        if stalls and watchdog.get("last_stall"):
+            last = watchdog["last_stall"]
+            alerts.append(
+                f"{red}stall{reset} {peer[:16]}: loop blocked "
+                f"{last.get('blocked_s_at_capture', '?')}s at {last.get('frame', '')}"
+                if "frame" in last
+                else f"{red}stall{reset} {peer[:16]}: {stalls} event-loop stall(s), "
+                f"max lag {watchdog.get('max_lag_s', '?')}s"
+            )
+        for span in (snapshot.get("slow_spans") or ())[:2]:
+            alerts.append(
+                f"{yellow}slow{reset} {peer[:16]}: {span.get('name')} "
+                f"{span.get('dur_ms')}ms {span.get('events', [])}"
+            )
+        for board_name, state in sorted(breakers.items()):
+            if isinstance(state, dict) and state.get("num_tripped"):
+                alerts.append(
+                    f"{yellow}breaker{reset} {peer[:16]}: {board_name} open against {state.get('tripped')}"
+                )
+        for metric_name, what in (
+            ("hivemind_optimizer_epoch_adopted_without_state_total", "epoch adopted WITHOUT state"),
+            ("hivemind_state_sync_unverified_adoptions_total", "unverified state adoption"),
+        ):
+            value = _metric_total(snapshot, metric_name)
+            if value:
+                alerts.append(f"{red}recovery{reset} {peer[:16]}: {value:g} {what}")
+
+    for peer, snapshot in sorted(records.items(), key=lambda kv: str(kv[0])):
+        # snapshots are DHT-supplied: one malformed (buggy, version-skewed,
+        # hostile) peer gets a flagged row, never a dead dashboard
+        try:
+            _render_peer(str(peer), snapshot if isinstance(snapshot, dict) else {})
+        except Exception as e:
+            logger.debug(f"malformed snapshot from {peer!r}: {e!r}")
+            lines.append(f"{str(peer)[:18]:<18} {red}<malformed snapshot>{reset}")
+
+    if straggler_board:
+        lines.append("")
+        lines.append(f"{bold}stragglers (merged from every peer's round ledger){reset}")
+        ranked = sorted(
+            straggler_board.items(),
+            key=lambda kv: (-kv[1]["rounds_slowest"], -kv[1]["excess_s"]),
+        )
+        for victim, score in ranked[:8]:
+            lines.append(
+                f"  {victim[:18]:<18} slowest in {score['rounds_slowest']:>4} round(s), "
+                f"+{score['excess_s']:.3f}s excess, reported by {score['reporters']} peer(s)"
+            )
+
+    if alerts:
+        lines.append("")
+        lines.append(f"{bold}recent alerts{reset}")
+        lines.extend(f"  {alert}" for alert in alerts[-12:])
+
+    text = "\n".join(lines)
+    if ansi:
+        text = _CLEAR + text
+    return text, samples_state
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    parser.add_argument("--initial_peers", nargs="*", default=[],
+                        help="multiaddrs of swarm members to read telemetry from")
+    parser.add_argument("--key", default=None,
+                        help="the swarm's telemetry DHT key (default: hivemind_telemetry)")
+    parser.add_argument("--interval", type=float, default=5.0, help="refresh period, seconds")
+    parser.add_argument("--publish_interval", type=float, default=DEFAULT_PUBLISH_INTERVAL,
+                        help="the swarm's TelemetryPublisher cadence; snapshots older "
+                             f"than {STALE_AFTER_FACTOR:g}x this are flagged STALE")
+    parser.add_argument("--frames", type=int, default=0,
+                        help="render this many frames then exit (0 = run until ^C)")
+    parser.add_argument("--no-ansi", action="store_true", dest="no_ansi",
+                        help="plain text frames, no screen clearing (piping / CI)")
+    args = parser.parse_args()
+
+    from hivemind_tpu.dht import DHT
+    from hivemind_tpu.telemetry.monitor import DEFAULT_TELEMETRY_KEY, fetch_swarm_telemetry
+
+    key = args.key or DEFAULT_TELEMETRY_KEY
+    dht = DHT(initial_peers=args.initial_peers, start=True)
+    prev_samples: Dict[str, Tuple[float, float]] = {}
+    rendered = 0
+    try:
+        while True:
+            try:
+                records = fetch_swarm_telemetry(dht, key)
+            except Exception as e:
+                logger.warning(f"telemetry fetch failed: {e!r}")
+                records = {}
+            frame, prev_samples = render_frame(
+                records,
+                publish_interval=args.publish_interval,
+                prev_samples=prev_samples,
+                ansi=not args.no_ansi,
+            )
+            print(frame, flush=True)
+            rendered += 1
+            if args.frames and rendered >= args.frames:
+                break
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        dht.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -121,6 +121,11 @@ class Server:
             future.result(timeout)
 
     async def _start(self) -> None:
+        # a stalled loop stops expert RPC dispatch AND batch draining at once:
+        # arm the watchdog with the server (idempotent; the DHT shares the loop)
+        from hivemind_tpu.telemetry.watchdog import ensure_watchdog
+
+        ensure_watchdog(asyncio.get_event_loop())
         await self.handler.add_p2p_handlers(await self.dht.replicate_p2p())
         self.runtime.start()
         if self.checkpoint_saver is not None:

@@ -34,6 +34,7 @@ from hivemind_tpu.optim.progress_tracker import ProgressTracker
 from hivemind_tpu.optim.recovery import LocalCheckpointStore, restore_from_local
 from hivemind_tpu.optim.state_averager import TrainingStateAverager
 from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
+from hivemind_tpu.telemetry.ledger import LEDGER as _LEDGER
 from hivemind_tpu.telemetry.tracing import trace as _tracing_span
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.timed_storage import get_dht_time
@@ -317,6 +318,11 @@ class Optimizer(ChronicFailureTracking):
                         scheduled_time=get_dht_time() + self._matchmaking_delay(),
                     )
             self._maybe_save_checkpoint(self.local_epoch)
+            _LEDGER.record_epoch(
+                self.local_epoch,
+                peer=str(self.dht.peer_id),
+                num_peers=self.tracker.global_progress.num_peers,
+            )
             self.tracker.update_epoch(self.local_epoch)
         return self.state_averager.params
 
@@ -405,6 +411,14 @@ class Optimizer(ChronicFailureTracking):
         # checkpoint AFTER the state-averaging round so the file holds the
         # swarm-averaged tensors this epoch actually produced
         self._maybe_save_checkpoint(next_epoch)
+        # attribution ledger (ISSUE 8): close this epoch's record AFTER both
+        # averaging rounds, so the rounds-since-last-epoch rollup covers them
+        _LEDGER.record_epoch(
+            next_epoch,
+            peer=str(self.dht.peer_id),
+            averaged_ok=averaged_ok,
+            num_peers=self.tracker.global_progress.num_peers,
+        )
         self.tracker.update_epoch(next_epoch)
         if self.verbose:
             logger.info(

@@ -11,11 +11,22 @@ distributed tracing with a per-process flight recorder.
   over stdlib HTTP (``--metrics-port`` in run_server.py / run_dht.py).
 - :mod:`~hivemind_tpu.telemetry.monitor` — per-peer DHT snapshot publisher and
   the swarm-wide aggregation view (now incl. breaker states + slow spans).
+- :mod:`~hivemind_tpu.telemetry.ledger` — the per-round attribution ledger
+  (ISSUE 8): one structured record per averaging round / optimizer epoch with
+  per-peer straggler scores, served at ``GET /ledger``.
+- :mod:`~hivemind_tpu.telemetry.watchdog` — event-loop lag probe with stall
+  stack capture and executor-queue-depth gauges.
 
 See docs/observability.md for the metric catalog and the span catalog.
 """
 
 from hivemind_tpu.telemetry.exporter import MetricsExporter, render_prometheus
+from hivemind_tpu.telemetry.ledger import LEDGER, RoundLedger
+from hivemind_tpu.telemetry.watchdog import (
+    EventLoopWatchdog,
+    ensure_watchdog,
+    watchdog_summary,
+)
 from hivemind_tpu.telemetry.tracing import (
     RECORDER,
     Span,
@@ -47,6 +58,11 @@ from hivemind_tpu.telemetry.registry import (
 __all__ = [
     "REGISTRY",
     "RECORDER",
+    "LEDGER",
+    "RoundLedger",
+    "EventLoopWatchdog",
+    "ensure_watchdog",
+    "watchdog_summary",
     "DEFAULT_BUCKETS",
     "DEFAULT_TELEMETRY_KEY",
     "Span",

@@ -14,6 +14,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from hivemind_tpu.telemetry.ledger import LEDGER, RoundLedger
 from hivemind_tpu.telemetry.registry import REGISTRY, MetricsRegistry
 from hivemind_tpu.telemetry.tracing import RECORDER, SpanRecorder, render_chrome_trace
 from hivemind_tpu.utils.logging import get_logger
@@ -76,6 +77,7 @@ def render_prometheus(registry: MetricsRegistry = REGISTRY) -> str:
 class _MetricsHandler(BaseHTTPRequestHandler):
     registry: MetricsRegistry = REGISTRY  # overridden per-server
     recorder: SpanRecorder = RECORDER  # overridden per-server
+    ledger: RoundLedger = LEDGER  # overridden per-server
 
     def do_GET(self):  # noqa: N802 (stdlib API)
         path = self.path.split("?", 1)[0]
@@ -85,6 +87,13 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             self.send_header("Content-Type", CONTENT_TYPE)
         elif path == "/metrics.json":
             body = json.dumps(self.registry.snapshot()).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+        elif path == "/ledger":
+            # raw round/epoch attribution records + straggler scores (ISSUE 8):
+            # "where did epoch N's wall time go, and which peer caused it" —
+            # serialization happens HERE, never on the record path
+            body = json.dumps(self.ledger.export(), default=str).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
         elif path == "/trace":
@@ -113,7 +122,8 @@ class _MetricsHandler(BaseHTTPRequestHandler):
 class MetricsExporter:
     """Serve ``/metrics`` (Prometheus text), ``/metrics.json`` (compact
     snapshot), ``/trace`` (Chrome trace-event JSON from the span flight
-    recorder) and ``/healthz`` on a daemon thread.
+    recorder), ``/ledger`` (raw per-round attribution records) and
+    ``/healthz`` on a daemon thread.
 
     :param port: TCP port; 0 picks a free one (read it back via ``.port``)
     :param host: bind host; default loopback — pass "0.0.0.0" for remote scrapers
@@ -125,12 +135,16 @@ class MetricsExporter:
         host: str = "127.0.0.1",
         registry: MetricsRegistry = REGISTRY,
         recorder: SpanRecorder = RECORDER,
+        ledger: RoundLedger = LEDGER,
         start: bool = True,
     ):
         self.registry = registry
         self.recorder = recorder
+        self.ledger = ledger
         handler = type(
-            "_BoundMetricsHandler", (_MetricsHandler,), {"registry": registry, "recorder": recorder}
+            "_BoundMetricsHandler",
+            (_MetricsHandler,),
+            {"registry": registry, "recorder": recorder, "ledger": ledger},
         )
         self._server = ThreadingHTTPServer((host, port), handler)
         self._server.daemon_threads = True

@@ -25,6 +25,11 @@ from hivemind_tpu.utils.timed_storage import get_dht_time
 logger = get_logger(__name__)
 
 DEFAULT_TELEMETRY_KEY = "hivemind_telemetry"
+# the default TelemetryPublisher cadence, and how many missed publishes make a
+# peer STALE — shared by SwarmMonitor.render_report and hivemind-top so the
+# two renderers can never disagree about staleness
+DEFAULT_PUBLISH_INTERVAL = 30.0
+STALE_AFTER_FACTOR = 3.0
 # a snapshot must stay a small DHT record: drop histogram series first, then
 # whole metrics, before giving up on the publish
 _MAX_SNAPSHOT_BYTES = 48 * 1024
@@ -41,7 +46,9 @@ def build_peer_snapshot(
     # lazy import: telemetry must stay importable before resilience (which
     # itself imports this package for its metrics)
     from hivemind_tpu.resilience import all_board_states
+    from hivemind_tpu.telemetry.ledger import LEDGER
     from hivemind_tpu.telemetry.tracing import RECORDER
+    from hivemind_tpu.telemetry.watchdog import watchdog_summary
 
     snapshot: Dict[str, Any] = {
         "time": get_dht_time(),
@@ -56,6 +63,15 @@ def build_peer_snapshot(
     recent = RECORDER.summaries(limit=30)
     if recent:
         snapshot["recent_spans"] = recent
+    # per-round attribution (ISSUE 8): recent records + straggler scores +
+    # epoch transitions ride the snapshot so ONE DHT read answers "which peer
+    # is taxing the swarm" without scraping anyone's /ledger
+    ledger = LEDGER.snapshot()
+    if ledger:
+        snapshot["ledger"] = ledger
+    watchdog = watchdog_summary()
+    if watchdog.get("loops"):
+        snapshot["watchdog"] = watchdog
     if extras:
         snapshot.update(extras)
     return snapshot
@@ -66,8 +82,17 @@ def _shrink_to_fit(snapshot: Dict[str, Any], max_bytes: int = _MAX_SNAPSHOT_BYTE
 
     if len(MSGPackSerializer.dumps(snapshot)) <= max_bytes:
         return snapshot
-    # span summaries are nice-to-have context; the health + counter core wins
-    for optional_key in ("recent_spans", "slow_spans"):
+    # span summaries are nice-to-have context; the health + counter core wins.
+    # Ledger records shrink before they drop: straggler scores are the most
+    # load-bearing part of the attribution layer, so they go last
+    ledger = snapshot.get("ledger")
+    if isinstance(ledger, dict) and "records" in ledger:
+        shrunk_ledger = {k: v for k, v in ledger.items() if k != "records"}
+        candidate = {**snapshot, "ledger": shrunk_ledger, "truncated": True}
+        if len(MSGPackSerializer.dumps(candidate)) <= max_bytes:
+            return candidate
+        snapshot = candidate
+    for optional_key in ("recent_spans", "slow_spans", "ledger"):
         if optional_key in snapshot:
             snapshot = {k: v for k, v in snapshot.items() if k != optional_key}
             snapshot["truncated"] = True
@@ -215,24 +240,41 @@ def aggregate_swarm_view(records: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
     peers: Dict[str, Dict[str, Any]] = {}
     now = get_dht_time()
     for peer, snapshot in records.items():
+        # snapshots are DHT-supplied: a malformed (buggy/version-skewed/hostile)
+        # peer contributes an error marker, never a crashed aggregation
+        try:
+            age = round(max(now - float(snapshot.get("time", now)), 0.0), 1)
+        except (TypeError, ValueError):
+            age = -1.0  # unparseable timestamp
         peers[peer] = {
-            "age_s": round(max(now - float(snapshot.get("time", now)), 0.0), 1),
+            "age_s": age,
             # recent_spans feed render_timeline, not the per-peer health line
             **{k: v for k, v in snapshot.items() if k not in ("metrics", "time", "peer_id", "recent_spans")},
         }
-        for name, family in (snapshot.get("metrics") or {}).items():
+        metrics = snapshot.get("metrics")
+        if not isinstance(metrics, dict):
+            if metrics is not None:
+                peers[peer]["malformed"] = True
+            continue
+        for name, family in metrics.items():
+            if not isinstance(family, dict):
+                continue
             ftype = family.get("type", "untyped")
             agg = totals.setdefault(name, {"type": ftype, "total": 0.0, "peers": 0})
             agg["peers"] += 1
-            for _label, value in (family.get("series") or {}).items():
-                if isinstance(value, dict):  # histogram: count/sum
-                    agg["total"] += float(value.get("count", 0))
-                    agg["sum"] = round(agg.get("sum", 0.0) + float(value.get("sum", 0.0)), 6)
-                else:
-                    agg["total"] += float(value)
-                    if ftype == "gauge":
-                        agg["min"] = min(agg.get("min", float(value)), float(value))
-                        agg["max"] = max(agg.get("max", float(value)), float(value))
+            series = family.get("series")
+            for _label, value in (series.items() if isinstance(series, dict) else ()):
+                try:
+                    if isinstance(value, dict):  # histogram: count/sum
+                        agg["total"] += float(value.get("count", 0))
+                        agg["sum"] = round(agg.get("sum", 0.0) + float(value.get("sum", 0.0)), 6)
+                    else:
+                        agg["total"] += float(value)
+                        if ftype == "gauge":
+                            agg["min"] = min(agg.get("min", float(value)), float(value))
+                            agg["max"] = max(agg.get("max", float(value)), float(value))
+                except (TypeError, ValueError):
+                    peers[peer]["malformed"] = True
     for agg in totals.values():
         agg["total"] = round(agg["total"], 6)
     return {"num_peers": len(records), "metrics": totals, "peers": peers}
@@ -242,10 +284,24 @@ class SwarmMonitor:
     """Fetch + aggregate on demand, optionally appending each view to a
     :class:`~hivemind_tpu.utils.profiling.JsonlMetricsSink`."""
 
-    def __init__(self, dht, key: str = DEFAULT_TELEMETRY_KEY, sink=None):
+    # the swarm's agreed TelemetryPublisher cadence: a peer whose snapshot age
+    # exceeds STALE_AFTER_FACTOR x this is flagged STALE (it stopped publishing
+    # — crashed, wedged, or partitioned — even if its last numbers look
+    # healthy). A class default so render-only monitors (tests build them
+    # without __init__) work.
+    publish_interval: float = DEFAULT_PUBLISH_INTERVAL
+
+    def __init__(
+        self,
+        dht,
+        key: str = DEFAULT_TELEMETRY_KEY,
+        sink=None,
+        publish_interval: float = DEFAULT_PUBLISH_INTERVAL,
+    ):
         self.dht = dht
         self.key = key
         self.sink = sink
+        self.publish_interval = publish_interval
 
     def poll(self) -> Dict[str, Any]:
         view = aggregate_swarm_view(fetch_swarm_telemetry(self.dht, self.key))
@@ -280,16 +336,75 @@ class SwarmMonitor:
             agg = view.get("metrics", {}).get(name)
             if agg and agg.get("total"):
                 lines.append(f"  RECOVERY ALERT: {agg['total']:g} {what} across the swarm")
+        stale_after = STALE_AFTER_FACTOR * self.publish_interval
         for peer, health in sorted(view.get("peers", {}).items()):
             breakers = health.get("breakers") or {}
             slow = health.get("slow_spans") or []
+            ledger = health.get("ledger") or {}
+            watchdog = health.get("watchdog") or {}
             marker = " DEGRADED" if breakers or slow else ""
-            lines.append(f"  peer {peer[:16]}…:{marker} {health}")
+            if float(health.get("age_s", 0.0)) > stale_after:
+                # stopped publishing: crashed, wedged, or partitioned — its
+                # numbers below are a snapshot of the PAST, not the present
+                marker = " STALE" + marker
+            printable = {
+                k: v for k, v in health.items() if k not in ("ledger", "watchdog")
+            }
+            lines.append(f"  peer {peer[:16]}…:{marker} {printable}")
             for board, state in sorted(breakers.items()):
                 lines.append(f"    breaker {board}: {state.get('num_tripped', 0)} tripped {state.get('tripped')}")
             for span in slow:
                 lines.append(
                     f"    slow span {span.get('name')}: {span.get('dur_ms')}ms events={span.get('events', [])}"
+                )
+            if watchdog.get("stalls"):
+                lines.append(
+                    f"    WATCHDOG: {watchdog['stalls']} event-loop stall(s), "
+                    f"max lag {watchdog.get('max_lag_s', 0.0)}s — this peer's loop blocked; "
+                    f"it is NOT a network straggler"
+                )
+            for victim, score in list((ledger.get("stragglers") or {}).items())[:3]:
+                lines.append(
+                    f"    straggler seen: {str(victim)[:16]} slowest in "
+                    f"{score.get('rounds_slowest', 0)} round(s), +{score.get('excess_s', 0.0)}s excess"
+                )
+        timeline = self.render_epoch_timeline(view)
+        if timeline:
+            lines.append(timeline)
+        return "\n".join(lines)
+
+    def render_epoch_timeline(self, view: Optional[Dict[str, Any]] = None) -> str:
+        """Per-epoch swarm timeline with straggler attribution (ISSUE 8): every
+        peer's ledger epoch records, grouped by epoch — one line per peer per
+        epoch showing rounds run, averaging seconds spent, and which partner was
+        slowest. This is "where did epoch N's wall time go" as one screen."""
+        view = view if view is not None else self.poll()
+        by_epoch: Dict[int, list] = {}
+        for peer, health in (view.get("peers") or {}).items():
+            for entry in (health.get("ledger") or {}).get("epochs") or ():
+                # snapshots are DHT-supplied: one malformed (buggy/stale/hostile)
+                # peer must not crash every operator's report
+                if isinstance(entry, dict) and isinstance(entry.get("epoch"), (int, float)):
+                    by_epoch.setdefault(int(entry["epoch"]), []).append((peer, entry))
+        if not by_epoch:
+            return ""
+        lines = ["  epoch timeline (rounds / averaging seconds / slowest partner):"]
+        for epoch in sorted(by_epoch)[-8:]:
+            lines.append(f"    epoch {epoch}:")
+            for peer, entry in sorted(by_epoch[epoch], key=lambda kv: kv[0]):
+                try:
+                    rounds = int(entry.get("rounds", 0) or 0)
+                    round_s = float(entry.get("round_s", 0.0) or 0.0)
+                except (TypeError, ValueError):
+                    lines.append(f"      {str(peer)[:16]:<16} <malformed ledger entry>")
+                    continue
+                straggler = entry.get("straggler")
+                attribution = f" slowest={str(straggler)[:16]}" if straggler else ""
+                averaged = entry.get("averaged_ok")
+                outcome = "" if averaged is None else (" ok" if averaged else " DEGRADED_TO_LOCAL")
+                lines.append(
+                    f"      {str(peer)[:16]:<16} {rounds} round(s) "
+                    f"{round_s:.3f}s{attribution}{outcome}"
                 )
         return "\n".join(lines)
 

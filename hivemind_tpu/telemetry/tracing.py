@@ -59,6 +59,21 @@ _current_span: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar
     "hivemind_current_span", default=None
 )
 
+# best-effort per-THREAD view of the innermost open `trace` block, for observers
+# that cannot read another thread's contextvars (the event-loop watchdog wants
+# "which span was executing when the loop stalled"). Only `trace` blocks update
+# it. While a thread is synchronously blocked INSIDE a trace block, the entry is
+# the blocking span; if the blocker runs outside any trace block (a bare loop
+# callback), the entry may be a suspended task's still-open span — the watchdog's
+# stall event then carries the accurate blocking FRAME but an approximate span
+# association. Dict ops are GIL-atomic.
+_THREAD_SPANS: Dict[int, "Span"] = {}
+
+
+def thread_current_span(thread_id: int) -> Optional["Span"]:
+    """The innermost `trace` block open on the given thread (best-effort)."""
+    return _THREAD_SPANS.get(thread_id)
+
 # one rng for id generation; seeded from the OS so forked peers diverge.
 # random.Random methods are atomic under the GIL — no lock needed.
 _ids = random.Random(int.from_bytes(os.urandom(8), "big") ^ os.getpid())
@@ -263,6 +278,26 @@ def start_span(
     return Span(name, trace_id=trace_id, parent_id=parent_id, attributes=attributes or None)
 
 
+# finished-span listeners (the round ledger subscribes here): called after the
+# recorder append, exceptions swallowed — attribution must never fail the
+# operation it observes. Kept as a plain list read without a lock (GIL-atomic);
+# registration happens at import/startup time.
+_SPAN_LISTENERS: List = []
+
+
+def add_span_listener(listener) -> None:
+    """Register ``listener(span)`` to run on every finished span."""
+    if listener not in _SPAN_LISTENERS:
+        _SPAN_LISTENERS.append(listener)
+
+
+def remove_span_listener(listener) -> None:
+    try:
+        _SPAN_LISTENERS.remove(listener)
+    except ValueError:
+        pass
+
+
 def finish_span(span: Optional[Span], recorder: Optional[SpanRecorder] = None) -> None:
     """Stamp the end time and append to the flight recorder. None-safe so call
     sites need no enabled-check of their own."""
@@ -270,6 +305,11 @@ def finish_span(span: Optional[Span], recorder: Optional[SpanRecorder] = None) -
         return
     span.end = time.perf_counter()
     (recorder if recorder is not None else RECORDER).record(span)
+    for listener in _SPAN_LISTENERS:
+        try:
+            listener(span)
+        except Exception as e:  # pragma: no cover - listeners must stay harmless
+            logger.debug(f"span listener failed on {span.name!r}: {e!r}")
 
 
 class trace:
@@ -278,7 +318,7 @@ class trace:
     way to instrument a code path; use :func:`start_span` only where a context
     manager cannot wrap the operation."""
 
-    __slots__ = ("_name", "_attributes", "_remote", "_parent", "span", "_token")
+    __slots__ = ("_name", "_attributes", "_remote", "_parent", "span", "_token", "_thread_prev")
 
     def __init__(
         self,
@@ -293,6 +333,7 @@ class trace:
         self._parent = parent
         self.span: Optional[Span] = None
         self._token = None
+        self._thread_prev: Optional[Span] = None
 
     def __enter__(self) -> Optional[Span]:
         if not enabled:
@@ -301,12 +342,26 @@ class trace:
             self._name, parent=self._parent, remote_context=self._remote, **self._attributes
         )
         self._token = _current_span.set(self.span)
+        tid = threading.get_ident()
+        self._thread_prev = _THREAD_SPANS.get(tid)
+        _THREAD_SPANS[tid] = self.span
         return self.span
 
     def __exit__(self, exc_type, exc, tb) -> bool:
         if self._token is not None:
             _current_span.reset(self._token)
             self._token = None
+            tid = threading.get_ident()
+            # interleaved asyncio tasks enter/exit in non-stack order: only
+            # restore when the table still points at US (otherwise a later
+            # task's live entry would be clobbered), and never reinstall a
+            # span that already finished while we were suspended
+            if _THREAD_SPANS.get(tid) is self.span:
+                if self._thread_prev is not None and self._thread_prev.end is None:
+                    _THREAD_SPANS[tid] = self._thread_prev
+                else:
+                    _THREAD_SPANS.pop(tid, None)
+            self._thread_prev = None
         if self.span is not None:
             if exc_type is not None:
                 self.span.add_event("error", type=exc_type.__name__)

@@ -46,6 +46,8 @@ def cleanup_children():
     import os
 
     from hivemind_tpu.resilience import CHAOS, reset_all_boards
+    from hivemind_tpu.telemetry import watchdog as telemetry_watchdog
+    from hivemind_tpu.telemetry.ledger import LEDGER
     from hivemind_tpu.telemetry.tracing import RECORDER
     from hivemind_tpu.utils.crypto import Ed25519PrivateKey
 
@@ -53,6 +55,8 @@ def cleanup_children():
     reset_all_boards()  # module-level breaker boards (e.g. moe EXPERT_BREAKERS) too
     RECORDER.clear()  # one test's spans must not satisfy another's assertions
     RECORDER.slow_threshold = float(os.environ.get("HIVEMIND_SLOW_SPAN_S", "10.0"))
+    LEDGER.clear()  # one test's round records must not satisfy another's assertions
+    telemetry_watchdog.shutdown_all()  # watchdog threads re-arm with the next loop owner
     Ed25519PrivateKey.reset_process_wide()
     gc.collect()
 

@@ -160,3 +160,44 @@ def test_compact_line_keeps_tpu_success_fields():
     assert parsed["extra"]["mfu"] == 0.374
     assert parsed["extra"]["attention"] == "flash"
     assert parsed["vs_baseline"] == 1.07
+
+
+def test_bench_artifact_embeds_ledger_and_watchdog_attribution():
+    """ISSUE 8: the averaging swarm's ledger + watchdog rollup rides the BENCH
+    artifact, so a perf regression carries attribution (rounds, per-phase
+    mean/p95, straggler scores, stall count, max loop lag), not just the
+    headline number."""
+    averaging = {
+        "value": 0.3,
+        "extra": {
+            "telemetry": {},
+            "attribution": {
+                "ledger": {
+                    "rounds": 12,
+                    "total_s": {"mean": 0.8, "p95": 1.4},
+                    "matchmaking_wait_s": {"mean": 0.4, "p95": 0.9},
+                    "stragglers": {"peerX": {"rounds_slowest": 7, "excess_s": 2.1}},
+                },
+                "watchdog": {"loops": ["hmtpu-loop"], "stalls": 0, "max_lag_s": 0.004},
+            },
+        },
+    }
+    section = bench.telemetry_section(averaging)
+    assert section["attribution"]["ledger"]["rounds"] == 12
+    assert section["attribution"]["ledger"]["total_s"]["p95"] == 1.4
+    assert section["attribution"]["watchdog"]["stalls"] == 0
+
+    result = _bloated_result()
+    result["extra"]["averaging_extra"] = dict(averaging["extra"])
+    # main() strips telemetry/attribution from the copied extra (they land once,
+    # under result["telemetry"]): mirror that here and assert the invariant
+    result["extra"]["averaging_extra"] = {
+        k: v for k, v in result["extra"]["averaging_extra"].items()
+        if k not in ("telemetry", "attribution")
+    }
+    result["telemetry"] = section
+    out, err = io.StringIO(), io.StringIO()
+    bench.emit(result, out=out, err=err)
+    full = json.loads(err.getvalue())
+    assert full["telemetry"]["attribution"]["ledger"]["stragglers"]["peerX"]["rounds_slowest"] == 7
+    assert "attribution" not in full["extra"]["averaging_extra"]

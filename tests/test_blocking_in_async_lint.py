@@ -1,0 +1,68 @@
+"""Tier-1 guard for the blocking-in-async lint (ISSUE 8 satellite): new
+``time.sleep`` / blocking file IO / sync socket calls inside ``async def``
+under p2p/dht/averaging/moe fail the suite — the event-loop watchdog catches
+such stalls at runtime, this keeps them from being merged at all."""
+
+import sys
+import textwrap
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+import check_blocking_in_async as lint  # noqa: E402
+
+
+def test_no_new_blocking_calls_in_async_defs():
+    new, stale = lint.check()
+    assert not new, (
+        "blocking call(s) inside async def on the swarm's event loop "
+        "(they stall every RPC/matchmaking/stream of this peer at once):\n  "
+        + "\n  ".join(new)
+        + "\nFix: await asyncio.sleep / run_in_executor / loop transports. "
+        "Only reviewed legacy sites belong in ALLOWLIST."
+    )
+    # stale entries are a warning, not a failure — but surface them
+    for entry in stale:
+        print(f"stale allowlist entry: {entry}")
+
+
+def test_lint_detects_each_rule(tmp_path):
+    """The lint must actually catch what it claims to catch (and not flag the
+    executor pattern), or the guard above is a no-op."""
+    package = tmp_path / "pkg"
+    for tree in lint.SCANNED_TREES:
+        (package / tree).mkdir(parents=True)
+        (package / tree / "__init__.py").write_text("")
+    (package / "p2p" / "bad.py").write_text(
+        textwrap.dedent(
+            """
+            import asyncio
+            import socket
+            import time
+
+            async def stalls_everything():
+                time.sleep(1.0)          # time-sleep
+                data = open("/tmp/x").read()   # blocking-io
+                conn = socket.create_connection(("h", 1))  # sync-socket
+                return data, conn
+
+            async def fine():
+                await asyncio.sleep(0.1)
+
+                def _work():            # executor pattern: sync def inside async
+                    time.sleep(1.0)
+                    with open("/tmp/y") as f:
+                        return f.read()
+
+                return await asyncio.get_event_loop().run_in_executor(None, _work)
+
+            def also_fine():
+                time.sleep(1.0)
+                return open("/tmp/z")
+            """
+        )
+    )
+    new, _stale = lint.check(package_root=package)
+    kinds = sorted(line.split("[")[1].split("]")[0] for line in new)
+    assert kinds == ["blocking-io", "sync-socket", "time-sleep"], new
+    assert all("stalls_everything" in line for line in new), new

@@ -1,0 +1,127 @@
+"""Event-loop watchdog (ISSUE 8): a deliberately injected ~250 ms loop block is
+detected with the blocking frame visible in the captured stack, healthy loops
+count zero stalls, executor backlogs are gauged, and the process-wide
+registration is idempotent."""
+
+import asyncio
+import time
+
+from hivemind_tpu.telemetry.registry import MetricsRegistry
+from hivemind_tpu.telemetry.tracing import trace
+from hivemind_tpu.telemetry import watchdog as watchdog_module
+from hivemind_tpu.telemetry.watchdog import (
+    EventLoopWatchdog,
+    active_watchdogs,
+    ensure_watchdog,
+    shutdown_all,
+    watchdog_summary,
+)
+
+
+def _blocking_call_the_watchdog_must_name():
+    time.sleep(0.25)  # the deliberately injected ≥250 ms event-loop block
+
+
+async def test_watchdog_detects_injected_block_and_names_the_frame():
+    registry = MetricsRegistry()
+    loop = asyncio.get_running_loop()
+    watchdog = EventLoopWatchdog(
+        loop, name="under-test", interval=0.02, stall_threshold=0.1, registry=registry
+    )
+    try:
+        await asyncio.sleep(0.15)  # a few healthy heartbeats identify the loop thread
+        with trace("allreduce.round", peer="me") as span:
+            _blocking_call_the_watchdog_must_name()
+        await asyncio.sleep(0.15)  # let the delayed heartbeat land and be observed
+    finally:
+        watchdog.shutdown()
+
+    assert watchdog.stalls >= 1
+    stall = watchdog.last_stall
+    assert stall is not None and stall["threshold_s"] == 0.1
+    # the captured stack names the exact blocking call, not just "loop was slow"
+    assert "_blocking_call_the_watchdog_must_name" in stall["stack"], stall["stack"]
+    assert "time.sleep(0.25)" in stall["stack"], stall["stack"]
+    # the stall landed as an event on the span that was active on the loop thread
+    events = {name: attrs for _t, name, attrs in (span.events or [])}
+    assert "event_loop.stall" in events, span.events
+    assert events["event_loop.stall"]["loop"] == "under-test"
+    assert "time.sleep" in events["event_loop.stall"]["frame"]
+    # metrics: the stall is counted and the ~250 ms lag reached the histogram
+    assert registry.get("hivemind_event_loop_stalls_total").value(loop="under-test") >= 1
+    lag = registry.get("hivemind_event_loop_lag_seconds").labels("under-test")
+    assert lag.count >= 2  # healthy beats + the stalled one
+    assert watchdog.max_lag >= 0.2
+
+
+async def test_healthy_loop_counts_zero_stalls():
+    registry = MetricsRegistry()
+    loop = asyncio.get_running_loop()
+    # the threshold stays generous: a loaded CI box can delay THREAD scheduling
+    # by hundreds of ms, which is host jitter, not an event-loop stall
+    watchdog = EventLoopWatchdog(
+        loop, name="healthy", interval=0.02, stall_threshold=2.0, registry=registry
+    )
+    try:
+        for _ in range(10):
+            await asyncio.sleep(0.02)  # cooperative awaits only: no stall
+    finally:
+        watchdog.shutdown()
+    assert watchdog.stalls == 0
+    assert registry.get("hivemind_event_loop_stalls_total").value(loop="healthy") == 0
+    assert registry.get("hivemind_event_loop_lag_seconds").labels("healthy").count >= 3
+
+
+async def test_executor_queue_depth_gauge():
+    registry = MetricsRegistry()
+    loop = asyncio.get_running_loop()
+    watchdog = EventLoopWatchdog(
+        loop, name="gauges", interval=0.02, stall_threshold=1.0, registry=registry, start=False
+    )
+    # asyncio_utils is imported by the package, so its pools are always visible
+    import hivemind_tpu.utils.asyncio_utils  # noqa: F401
+
+    watchdog._sample_executors()
+    gauge = registry.get("hivemind_executor_queue_depth")
+    assert gauge is not None
+    depths = {key[0]: child.value for key, child in gauge.series()}
+    assert "blocking" in depths and depths["blocking"] >= 0
+    assert "lock" in depths
+
+
+async def test_ensure_watchdog_is_idempotent_per_loop_and_respects_kill_switch():
+    loop = asyncio.get_running_loop()
+    shutdown_all()
+    try:
+        first = ensure_watchdog(loop, name="shared")
+        second = ensure_watchdog(loop, name="other-name")
+        assert first is not None and second is first  # one loop, one watchdog
+        assert first in active_watchdogs()
+        summary = watchdog_summary()
+        assert summary["loops"] == ["shared"] and summary["stalls"] == 0
+        assert summary["max_lag_s"] >= 0.0
+
+        original = watchdog_module.enabled
+        watchdog_module.enabled = False
+        try:
+            shutdown_all()
+            assert ensure_watchdog(loop, name="disabled") is None
+            assert active_watchdogs() == []
+        finally:
+            watchdog_module.enabled = original
+    finally:
+        shutdown_all()
+
+
+def test_watchdogs_armed_by_swarm_components():
+    """DHT startup arms the process-wide watchdog on the shared loop (the
+    averager and MoE server share it) — no operator action required."""
+    from hivemind_tpu.dht import DHT
+
+    shutdown_all()
+    dht = DHT(start=True)
+    try:
+        assert active_watchdogs(), "starting a DHT must arm the event-loop watchdog"
+    finally:
+        dht.shutdown()
+        shutdown_all()

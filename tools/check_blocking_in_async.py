@@ -1,0 +1,175 @@
+#!/usr/bin/env python3
+"""Lint: no blocking calls inside ``async def`` on the swarm's event loop
+(ISSUE 8 satellite).
+
+The entire stack shares one asyncio loop (utils/loop.py): a single synchronous
+call inside a coroutine stalls matchmaking, DHT RPCs and part streams for the
+whole process — and to the rest of the swarm the peer looks like a network
+straggler. The watchdog (telemetry/watchdog.py) catches such stalls at
+runtime; this lint keeps new ones from being written at all. Scanned trees:
+
+    p2p/, dht/, averaging/, moe/
+
+Rules — flagged only when the INNERMOST enclosing function is ``async def``
+(a nested sync ``def`` is the standard run-in-executor pattern and is fine):
+
+1. ``time-sleep`` — ``time.sleep(...)`` (or a bare ``sleep`` imported from
+   ``time``): use ``await asyncio.sleep(...)``.
+2. ``blocking-io`` — ``open(...)`` or ``Path``-style ``.read_text()`` /
+   ``.read_bytes()`` / ``.write_text()`` / ``.write_bytes()``: move file IO
+   into ``run_in_executor`` (utils/asyncio_utils.py).
+3. ``sync-socket`` — ``socket.socket(...)`` / ``socket.create_connection(...)``
+   / ``socket.getaddrinfo(...)`` / ``socket.socketpair(...)``: use the loop's
+   transport APIs (``loop.sock_*``, ``open_connection``) or an executor.
+
+Findings are keyed ``(relative path, enclosing def, kind)`` — stable across
+line-number churn. Pre-existing occurrences are grandfathered in ``ALLOWLIST``;
+the wired-in test (tests/test_blocking_in_async_lint.py) fails on anything NEW
+and warns on stale entries so the list shrinks over time.
+
+Run directly (``python tools/check_blocking_in_async.py``) or via the test.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List, Set, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PACKAGE_ROOT = REPO_ROOT / "hivemind_tpu"
+
+SCANNED_TREES = ("p2p", "dht", "averaging", "moe")
+
+Finding = Tuple[str, str, str]  # (relpath, enclosing function, kind)
+
+# Pre-existing sites, reviewed and grandfathered (do not add new ones — fix the
+# code instead). Currently EMPTY: the scanned trees are clean; keep them so.
+ALLOWLIST: Set[Finding] = set()
+
+_PATHLIKE_IO_METHODS = {"read_text", "read_bytes", "write_text", "write_bytes"}
+_SOCKET_BLOCKING_FUNCS = {"socket", "create_connection", "getaddrinfo", "socketpair"}
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, relpath: str):
+        self.relpath = relpath
+        self.findings: List[Tuple[Finding, int]] = []
+        self._scope: List[str] = []
+        # parallel stack: is the function at this scope level async?
+        self._func_kind: List[str] = []  # "async" | "sync" | "class"
+
+    # --- scope tracking -------------------------------------------------
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._scope.append(node.name)
+        self._func_kind.append("sync")
+        self.generic_visit(node)
+        self._func_kind.pop()
+        self._scope.pop()
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self._scope.append(node.name)
+        self._func_kind.append("async")
+        self.generic_visit(node)
+        self._func_kind.pop()
+        self._scope.pop()
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._scope.append(node.name)
+        self._func_kind.append("class")
+        self.generic_visit(node)
+        self._func_kind.pop()
+        self._scope.pop()
+
+    def _in_async_function(self) -> bool:
+        """True when the innermost enclosing FUNCTION is async (classes are
+        transparent: a method defined in a class inside an async def counts by
+        the method's own kind)."""
+        for kind in reversed(self._func_kind):
+            if kind == "class":
+                continue
+            return kind == "async"
+        return False
+
+    def _qualname(self) -> str:
+        return ".".join(self._scope) if self._scope else "<module>"
+
+    def _record(self, kind: str, lineno: int) -> None:
+        self.findings.append(((self.relpath, self._qualname(), kind), lineno))
+
+    # --- rules ----------------------------------------------------------
+    def visit_Call(self, node: ast.Call):
+        if self._in_async_function():
+            fn = node.func
+            if isinstance(fn, ast.Attribute):
+                owner = fn.value
+                if isinstance(owner, ast.Name):
+                    if owner.id == "time" and fn.attr == "sleep":
+                        self._record("time-sleep", node.lineno)
+                    elif owner.id == "socket" and fn.attr in _SOCKET_BLOCKING_FUNCS:
+                        self._record("sync-socket", node.lineno)
+                if fn.attr in _PATHLIKE_IO_METHODS:
+                    self._record("blocking-io", node.lineno)
+            elif isinstance(fn, ast.Name):
+                if fn.id == "open":
+                    self._record("blocking-io", node.lineno)
+                elif fn.id == "sleep" and self._imported_time_sleep:
+                    self._record("time-sleep", node.lineno)
+        self.generic_visit(node)
+
+    _imported_time_sleep = False
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        if node.module == "time" and any(alias.name == "sleep" for alias in node.names):
+            self._imported_time_sleep = True
+        self.generic_visit(node)
+
+
+def collect_findings(package_root: Path = PACKAGE_ROOT) -> List[Tuple[Finding, int]]:
+    findings: List[Tuple[Finding, int]] = []
+    for tree_name in SCANNED_TREES:
+        for path in sorted((package_root / tree_name).rglob("*.py")):
+            relpath = str(path.relative_to(package_root))
+            tree = ast.parse(path.read_text(), filename=str(path))
+            visitor = _Visitor(relpath)
+            visitor.visit(tree)
+            findings.extend(visitor.findings)
+    return findings
+
+
+_ADVICE = {
+    "time-sleep": "use `await asyncio.sleep(...)` — time.sleep blocks the whole swarm loop",
+    "blocking-io": "move file IO off the loop (run_in_executor in utils/asyncio_utils.py)",
+    "sync-socket": "use the loop's transports (open_connection / loop.sock_*) or an executor",
+}
+
+
+def check(package_root: Path = PACKAGE_ROOT) -> Tuple[List[str], List[str]]:
+    """Returns (new_violations, stale_allowlist_entries) as printable strings."""
+    found = collect_findings(package_root)
+    found_keys = {key for key, _lineno in found}
+    new = [
+        f"{key[0]}:{lineno} [{key[2]}] in {key[1]} — {_ADVICE[key[2]]}"
+        for key, lineno in sorted(found)
+        if key not in ALLOWLIST
+    ]
+    stale = [f"{entry[0]} [{entry[2]}] in {entry[1]}" for entry in sorted(ALLOWLIST - found_keys)]
+    return new, stale
+
+
+def main() -> int:
+    new, stale = check()
+    for entry in stale:
+        print(f"note: stale allowlist entry (cleaned up — remove it): {entry}")
+    if new:
+        print(f"{len(new)} blocking call(s) inside async def on the swarm loop:")
+        for violation in new:
+            print(f"  {violation}")
+        return 1
+    print("ok: no blocking calls inside async def under p2p/dht/averaging/moe")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
