@@ -109,6 +109,32 @@ def _averaging_gbps(timeout: float = 420.0):
     return None
 
 
+def _llama_serving(timeout: float = 420.0):
+    """Third driver metric: Petals-style checkpoint-served KV-cache decode tok/s
+    (CPU-bound RPC + device dispatch, does not need the TPU), carrying the
+    serving-attribution summary (ISSUE 9) in its extra. Subprocess so a serving
+    hang can't take down the bench."""
+    import os
+    import subprocess
+    import sys
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmarks", "benchmark_llama_serving.py")
+    try:
+        run = subprocess.run(
+            [sys.executable, script, "--platform", "cpu", "--hidden_dim", "256",
+             "--inner", "704", "--layers", "2", "--generate", "32"],
+            timeout=timeout, capture_output=True, text=True,
+        )
+        for line in run.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+    except (subprocess.TimeoutExpired, json.JSONDecodeError, OSError):
+        pass
+    return None
+
+
 def measure_main(force_cpu: bool = False) -> dict:
     """The device measurement (no averaging metric): returns the result dict.
     Run via ``bench.py --_measure`` in a subprocess so a TPU runtime that wedges
@@ -418,7 +444,7 @@ def compact_result(result: dict, max_chars: int = 1500) -> str:
     return line
 
 
-def telemetry_section(averaging=None) -> dict:
+def telemetry_section(averaging=None, serving=None) -> dict:
     """The telemetry snapshot embedded in every BENCH artifact (ISSUE 2): the
     bench process's own registry plus the averaging swarm's snapshot (shipped
     through the subprocess's JSON extra), so round artifacts carry a per-phase
@@ -442,6 +468,12 @@ def telemetry_section(averaging=None) -> dict:
     attribution = averaging_extra.get("attribution")
     if attribution:
         section["attribution"] = attribution
+    # ISSUE 9: the serving swarm's per-request attribution summary (per-expert
+    # p50/p95, phase decomposition, batch occupancy, shed count) rides under
+    # "serving" — a serving regression's artifact names the phase that moved
+    serving_extra = (serving or {}).get("extra") or {}
+    if serving_extra.get("serving"):
+        section["serving"] = serving_extra["serving"]
     return section
 
 
@@ -464,6 +496,7 @@ def main() -> None:
     if _probe_point("round_start", probe_log, attempts=3):
         result = _try_measure(diagnostics)
     averaging = _averaging_gbps()
+    serving = _llama_serving()
     if result is None or result.get("tpu_unavailable"):
         # a tunnel wedged at round start may be free now (the averaging swarm just
         # bought several minutes): probe again mid-round
@@ -481,6 +514,7 @@ def main() -> None:
 
     result.setdefault("extra", {})
     result["extra"]["averaging_gbps_per_peer"] = (averaging or {}).get("value")
+    result["extra"]["llama_serving_tok_s"] = (serving or {}).get("value")
     # the swarm telemetry + attribution snapshots land ONCE, in
     # result["telemetry"] below — strip them from the copied extra so the
     # artifact does not carry them twice
@@ -494,7 +528,7 @@ def main() -> None:
     # co-tenancy swing shows up as a control swing right next to the number
     result["extra"]["host_control"] = {"at_start": control_start, "at_end": control_end}
     result["tpu_probe_log"] = probe_log
-    result["telemetry"] = telemetry_section(averaging)
+    result["telemetry"] = telemetry_section(averaging, serving)
     if diagnostics:
         result["tpu_measure_errors"] = diagnostics
     emit(result)

@@ -124,6 +124,11 @@ def main():
                 pos = args.prompt + t
                 pipe.decode_step(hidden[:, pos : pos + 1], "bench")
             elapsed = time.perf_counter() - start
+            # serving attribution rides the artifact (ISSUE 9): the server ran
+            # in-process, so the global ledger holds every request's phase
+            # decomposition — bench.py lands this under telemetry.serving
+            from hivemind_tpu.telemetry.serving import SERVING_LEDGER
+
             print(json.dumps({
                 "metric": "llama_checkpoint_decode",
                 "value": round(args.generate / elapsed, 1),
@@ -142,6 +147,7 @@ def main():
                     "planned_blocks_16gb_8sessions": plan_16gb,
                     "prompt": args.prompt, "generated": args.generate,
                     "prefill_included_tok_s": round((args.prompt + args.generate) / elapsed, 1),
+                    "serving": SERVING_LEDGER.summary(),
                 },
             }))
         finally:

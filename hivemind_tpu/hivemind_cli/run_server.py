@@ -27,6 +27,15 @@ def main():
     parser.add_argument("--decode_max_len", type=int, default=256,
                         help="KV-cache decode session capacity (prompt + generated "
                              "tokens) per client session")
+    parser.add_argument("--decode_max_sessions", type=int, default=64,
+                        help="LRU cap on concurrent KV-cache decode sessions "
+                             "(occupancy/evictions are gauged — see "
+                             "docs/observability.md 'Serving')")
+    parser.add_argument("--max_queue_size", type=int, default=1024,
+                        help="bounded task-pool queue: submits past this many "
+                             "waiting tasks are SHED with ServerOverloadedError "
+                             "(counted in hivemind_moe_shed_total) instead of "
+                             "queueing unboundedly toward client timeouts")
     parser.add_argument("--custom_module_path", default=None,
                         help="path to a .py file whose @register_expert_class "
                              "decorators run before the server starts (capability "
@@ -123,6 +132,8 @@ def main():
         dht=dht,
         checkpoint_dir=Path(args.checkpoint_dir) if args.checkpoint_dir else None,
         decode_max_len=args.decode_max_len,
+        decode_max_sessions=args.decode_max_sessions,
+        max_queue_size=args.max_queue_size,
         optim_factory=lambda: optax.adam(args.learning_rate),
         start=True,
     )
@@ -210,6 +221,7 @@ def _serve_llama_checkpoint(args) -> Server:
         # the HBM plan reserved KV space for exactly this many sessions: cap the
         # session manager to it so the reservation is real, not advisory
         decode_max_sessions=args.decode_sessions_budget,
+        max_queue_size=args.max_queue_size,
     )
     server.run_in_background(await_ready=True)
     return server

@@ -9,7 +9,12 @@ standing questions without Prometheus or Perfetto:
   round ledger: which partner was slowest, how often, and how many excess
   seconds it cost the swarm;
 - **recent alerts** — watchdog stalls (with the blocking frame), recovery
-  emergencies, slow spans, degraded rounds.
+  emergencies, slow spans, degraded rounds;
+- **serving board** (``--serving``, ISSUE 9) — per-expert QPS (frame-to-frame
+  request delta), p95 latency and sheds merged across every peer's serving
+  section, per-peer saturation (queue depth, runtime utilization, decode
+  session occupancy), degraded client-side scorecards, and the slowest-request
+  exemplars with their queue/assembly/compute/serialize decomposition.
 
 Everything renders from the DHT-published snapshots (`--key` must match the
 swarm's ``TelemetryPublisher`` key), so the dashboard is a pure *reader*: it
@@ -206,6 +211,89 @@ def render_frame(
     return text, samples_state
 
 
+def render_serving_board(
+    records: Dict[str, Dict[str, Any]],
+    *,
+    prev_requests: Optional[Dict[Tuple[str, str], Tuple[float, float]]] = None,
+    now: Optional[float] = None,
+    ansi: bool = True,
+) -> Tuple[str, Dict[Tuple[str, str], Tuple[float, float]]]:
+    """The ``--serving`` board (ISSUE 9). Pure: no DHT, no IO. Parsing lives
+    in ``telemetry.serving.collect_swarm_serving`` (shared with
+    ``SwarmMonitor.render_serving_board``); only the formatting is here.
+
+    ``prev_requests`` maps (peer, expert) -> (request_count, frame_time) from
+    the previous frame; returned updated so the caller can thread it through
+    for the QPS column (same pattern as ``prev_samples`` in render_frame)."""
+    from hivemind_tpu.telemetry.serving import (
+        collect_swarm_serving,
+        format_saturation_parts,
+        format_scorecard_line,
+        format_slowest_line,
+    )
+
+    now = now if now is not None else time.time()
+    bold = _BOLD if ansi else ""
+    red = _RED if ansi else ""
+    reset = _RESET if ansi else ""
+    data = collect_swarm_serving(records)
+    request_state: Dict[Tuple[str, str], Tuple[float, float]] = {}
+
+    lines: List[str] = [f"{bold}serving board{reset} — per-expert requests / QPS / p95 / sheds"]
+    header = f"{'expert':<24} {'peer':<14} {'req':>7} {'qps':>6} {'p95 ms':>8} {'shed':>5}"
+    lines.append(bold + header + reset)
+    rows: List[str] = []
+    for peer, uid, stats in data["experts"]:
+        requests = stats["requests"]
+        request_state[(peer, uid)] = (requests, now)
+        qps = None
+        if prev_requests and (peer, uid) in prev_requests:
+            prev_count, prev_time = prev_requests[(peer, uid)]
+            if now > prev_time:
+                qps = max(requests - prev_count, 0.0) / (now - prev_time)
+        p95 = stats["p95_s"]
+        sheds = stats["sheds"]
+        # pad BEFORE colorizing: escape codes inside a width spec eat the
+        # padding and misalign exactly the rows the operator cares about
+        shed_field = f"{sheds:>5}"
+        rows.append(
+            f"{uid[:24]:<24} {peer[:14]:<14} {requests:>7.0f} "
+            f"{(f'{qps:.1f}' if qps is not None else '-'):>6} "
+            f"{(f'{p95 * 1e3:.1f}' if p95 is not None else '-'):>8} "
+            + (f"{red}{shed_field}{reset}" if sheds else shed_field)
+        )
+    malformed_rows = [
+        f"{peer[:24]:<24} {red}<malformed serving section>{reset}"
+        for peer in data["malformed"]
+    ]
+
+    saturation_rows = [
+        f"  {peer[:16]:<16} {', '.join(format_saturation_parts(entry, red=red, reset=reset))}"
+        for peer, entry in data["saturation"]
+    ]
+
+    if not rows and not malformed_rows and not saturation_rows:
+        lines.append("  (no serving traffic reported by any peer)")
+    lines.extend(rows[:20])
+    lines.extend(malformed_rows)  # never capped away: a broken peer must show
+    if saturation_rows:
+        lines.append(f"{bold}saturation{reset}")
+        lines.extend(saturation_rows)
+    if data["degraded_scorecards"]:
+        lines.append(f"{bold}degraded scorecards (client view){reset}")
+        lines.extend(
+            "  " + format_scorecard_line(peer, uid, card)
+            for peer, uid, card in data["degraded_scorecards"][:8]
+        )
+    if data["slowest"]:
+        lines.append(f"{bold}slowest requests (queue/assembly/compute/serialize){reset}")
+        lines.extend(
+            "  " + format_slowest_line(total_s, peer, record)
+            for total_s, peer, record in data["slowest"][:5]
+        )
+    return "\n".join(lines), request_state
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
@@ -222,6 +310,9 @@ def main() -> None:
                         help="render this many frames then exit (0 = run until ^C)")
     parser.add_argument("--no-ansi", action="store_true", dest="no_ansi",
                         help="plain text frames, no screen clearing (piping / CI)")
+    parser.add_argument("--serving", action="store_true",
+                        help="append the serving board: per-expert QPS/p95/sheds, "
+                             "saturation, scorecards, slowest-request exemplars")
     args = parser.parse_args()
 
     from hivemind_tpu.dht import DHT
@@ -230,6 +321,7 @@ def main() -> None:
     key = args.key or DEFAULT_TELEMETRY_KEY
     dht = DHT(initial_peers=args.initial_peers, start=True)
     prev_samples: Dict[str, Tuple[float, float]] = {}
+    prev_requests: Dict[Tuple[str, str], Tuple[float, float]] = {}
     rendered = 0
     try:
         while True:
@@ -244,6 +336,11 @@ def main() -> None:
                 prev_samples=prev_samples,
                 ansi=not args.no_ansi,
             )
+            if args.serving:
+                board, prev_requests = render_serving_board(
+                    records, prev_requests=prev_requests, ansi=not args.no_ansi
+                )
+                frame = f"{frame}\n\n{board}"
             print(frame, flush=True)
             rendered += 1
             if args.frames and rendered >= args.frames:

@@ -27,6 +27,7 @@ import numpy as np
 from hivemind_tpu.moe.client.expert import RemoteExpert
 from hivemind_tpu.resilience import CHAOS as _CHAOS
 from hivemind_tpu.resilience import BreakerBoard, BreakerOpenError
+from hivemind_tpu.telemetry.serving import is_overload_error as _is_overload_error
 from hivemind_tpu.telemetry.tracing import trace as _tracing_span
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.loop import get_loop_runner
@@ -158,7 +159,11 @@ class RemoteCallMany:
                         # not fresh evidence — the breaker already holds the failure
                         logger.debug(str(e))
                     except Exception as e:
-                        EXPERT_BREAKERS.register_failure(uid)
+                        # a server shed (ServerOverloadedError over the wire) was
+                        # already fed to the breaker by RemoteExpert._call — do
+                        # not double-count one shed as two failures
+                        if not _is_overload_error(e):
+                            EXPERT_BREAKERS.register_failure(uid)
                         logger.warning(f"expert {uid} failed: {e!r}; masking it out")
                 if (
                     soft_deadline is None

@@ -10,6 +10,7 @@ threshold (reference expert.py:149-191)."""
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -20,6 +21,7 @@ from hivemind_tpu.compression import deserialize_tensor, serialize_tensor, split
 from hivemind_tpu.moe.expert_uid import IDEMPOTENT_CONNECTION_RPCS, ExpertInfo
 from hivemind_tpu.p2p import P2P, PeerID
 from hivemind_tpu.proto import runtime_pb2
+from hivemind_tpu.telemetry.serving import SCORECARDS, is_overload_error
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.loop import LoopRunner, get_loop_runner
 from hivemind_tpu.utils.serializer import MSGPackSerializer
@@ -77,6 +79,32 @@ class RemoteExpert:
     # ------------------------------------------------------------------ raw RPC
 
     async def _call(
+        self, method: str, tensors: Sequence[np.ndarray], metadata: bytes = b""
+    ) -> List[np.ndarray]:
+        """One expert RPC, scorecarded (ISSUE 9): every outcome — success,
+        failure, timeout/cancellation, server shed — lands on this expert's
+        per-client scorecard, and a shed additionally feeds the expert's
+        circuit breaker (the server said "overloaded", which is exactly the
+        evidence the breaker exists to accumulate)."""
+        started = time.perf_counter()
+        try:
+            result = await self._call_inner(method, tensors, metadata)
+        except BaseException as e:
+            SCORECARDS.record(
+                self.uid, time.perf_counter() - started, ok=False, kind=method, error=e
+            )
+            if isinstance(e, Exception) and is_overload_error(e):
+                # feed the shed into the expert's breaker HERE (the one choke
+                # point every caller shares); call_many skips its own
+                # register_failure for overloads so a shed counts exactly once
+                from hivemind_tpu.moe.client.call_many import EXPERT_BREAKERS
+
+                EXPERT_BREAKERS.register_failure(self.uid)
+            raise
+        SCORECARDS.record(self.uid, time.perf_counter() - started, ok=True, kind=method)
+        return result
+
+    async def _call_inner(
         self, method: str, tensors: Sequence[np.ndarray], metadata: bytes = b""
     ) -> List[np.ndarray]:
         serialized = [serialize_tensor(np.asarray(t, np.float32)) for t in tensors]

@@ -71,6 +71,15 @@ class RemoteMixtureOfExperts:
             self._experts[info.uid] = RemoteExpert(info, self.p2p)
         return self._experts[info.uid]
 
+    def expert_scorecards(self) -> Dict[str, dict]:
+        """This client's serving scorecards (ISSUE 9) for the experts this
+        mixture has called: success rate, latency quantiles, timeouts, sheds —
+        the caller-side view that rides the DHT telemetry snapshot."""
+        from hivemind_tpu.telemetry.serving import SCORECARDS
+
+        cards = SCORECARDS.export()
+        return {uid: cards[uid] for uid in self._experts if uid in cards}
+
     def _split_scores(self, flat_scores: jax.Array) -> List[jax.Array]:
         out, offset = [], 0
         for size in self.grid_size:

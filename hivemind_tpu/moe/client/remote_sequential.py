@@ -437,6 +437,20 @@ class RemoteSequential:
         with self._lock:
             self._decode_routes.pop(session_id, None)
 
+    def block_scorecards(self) -> Dict[str, dict]:
+        """Per-block serving scorecards (ISSUE 9): this client's observed
+        success rate / latency quantiles / timeouts / sheds for each pipeline
+        block it has called — which block (and therefore which server) is
+        degrading the pipeline, from the caller's side."""
+        from hivemind_tpu.telemetry.serving import SCORECARDS
+
+        cards = SCORECARDS.export()
+        return {
+            uid: cards[uid]
+            for uid in (self.block_uid(index) for index in range(self.num_blocks))
+            if uid in cards
+        }
+
     def decode_capacity(self) -> Optional[int]:
         """The tightest ``decode_max_len`` across the pipeline's current servers
         (each advertises it via rpc_info), or None if a block lacks sessions."""

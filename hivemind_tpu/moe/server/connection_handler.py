@@ -1,9 +1,17 @@
 """Expert RPC endpoints (capability parity: reference
 hivemind/moe/server/connection_handler.py:22-177 — there N forked handler processes;
-here one asyncio servicer feeding the task pools directly)."""
+here one asyncio servicer feeding the task pools directly).
+
+Serving attribution (ISSUE 9): every expert RPC runs inside a ``serving.request``
+span — a child of the ``p2p.handle:`` span, which already joined the remote
+caller's trace via cross-peer propagation, so the request's phase decomposition
+(queue-wait / batch-assembly / device-compute stamped by the TaskPool, serialize
+stamped here) lands in the CALLER's trace and in the process-wide
+:data:`~hivemind_tpu.telemetry.serving.SERVING_LEDGER`."""
 
 from __future__ import annotations
 
+import time
 from typing import AsyncIterator, Dict, List
 
 import numpy as np
@@ -20,6 +28,8 @@ from hivemind_tpu.moe.server.module_backend import ModuleBackend
 from hivemind_tpu.moe.server.task_pool import TaskPool
 from hivemind_tpu.p2p import P2P, P2PContext, ServicerBase
 from hivemind_tpu.proto import runtime_pb2
+from hivemind_tpu.telemetry.serving import SERVING_SPAN, accrue_span_phase
+from hivemind_tpu.telemetry.tracing import trace as _trace
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.serializer import MSGPackSerializer
 
@@ -34,7 +44,7 @@ class ConnectionHandler(ServicerBase):
     _idempotent_rpcs = IDEMPOTENT_CONNECTION_RPCS
 
     def __init__(self, backends: Dict[str, ModuleBackend], decode_max_len: int = 256,
-                 decode_max_sessions: int = 64):
+                 decode_max_sessions: int = 64, max_queue_size: int = 1024):
         from hivemind_tpu.moe.server.decode_session import DecodeSessionManager
 
         self.backends = backends
@@ -45,14 +55,33 @@ class ConnectionHandler(ServicerBase):
         )
         for name, backend in backends.items():
             self.forward_pools[name] = TaskPool(
-                backend.forward, f"{name}_forward", max_batch_size=backend.max_batch_size
+                backend.forward, f"{name}_forward", max_batch_size=backend.max_batch_size,
+                max_queue_size=max_queue_size,
             )
             self.backward_pools[name] = TaskPool(
-                backend.backward, f"{name}_backward", max_batch_size=backend.max_batch_size
+                backend.backward, f"{name}_backward", max_batch_size=backend.max_batch_size,
+                max_queue_size=max_queue_size,
             )
 
     def all_pools(self) -> List[TaskPool]:
         return list(self.forward_pools.values()) + list(self.backward_pools.values())
+
+    @staticmethod
+    def _serving_trace(kind: str, uid: str, context: P2PContext, tensors=None) -> _trace:
+        """The per-request serving span (ServingLedger assembles one record per
+        finished span; see telemetry/serving.py). ``client`` is the remote
+        caller — per-client attribution rides every record."""
+        attributes = {
+            "kind": kind,
+            "expert": uid,
+            "peer": str(context.local_id),
+            "client": str(context.remote_id),
+        }
+        if tensors:
+            first = tensors[0]
+            if getattr(first, "ndim", 0):
+                attributes["batch"] = int(first.shape[0])
+        return _trace(SERVING_SPAN, **attributes)
 
     # ------------------------------------------------------------------ RPCs
 
@@ -126,17 +155,34 @@ class ConnectionHandler(ServicerBase):
             grads = await self._run_backward(span_uid, [*inputs, *grads])
         return grads
 
+    @staticmethod
+    def _serialize_timed(outputs: List[np.ndarray]) -> List:
+        """Serialize the response tensors, accruing the serialize phase onto the
+        active serving span (the fourth slice of the request decomposition)."""
+        start = time.perf_counter()
+        serialized = [serialize_tensor(o) for o in outputs]
+        accrue_span_phase("serialize_s", time.perf_counter() - start)
+        return serialized
+
     async def rpc_forward(self, request: runtime_pb2.ExpertRequest, context: P2PContext) -> runtime_pb2.ExpertResponse:
         inputs = [deserialize_tensor(t) for t in request.tensors]
-        uids = self._span_uids(request.uid, request.metadata)
-        outputs = await self._run_forward_span(uids, inputs)
-        return runtime_pb2.ExpertResponse(tensors=[serialize_tensor(o) for o in outputs])
+        with self._serving_trace("forward", request.uid, context, inputs) as span:
+            uids = self._span_uids(request.uid, request.metadata)
+            if span is not None and len(uids) > 1:
+                span.set("span_len", len(uids))
+            outputs = await self._run_forward_span(uids, inputs)
+            serialized = self._serialize_timed(outputs)
+        return runtime_pb2.ExpertResponse(tensors=serialized)
 
     async def rpc_backward(self, request: runtime_pb2.ExpertRequest, context: P2PContext) -> runtime_pb2.ExpertResponse:
         inputs = [deserialize_tensor(t) for t in request.tensors]
-        uids = self._span_uids(request.uid, request.metadata)
-        grads = await self._run_backward_span(uids, inputs)
-        return runtime_pb2.ExpertResponse(tensors=[serialize_tensor(g) for g in grads])
+        with self._serving_trace("backward", request.uid, context, inputs) as span:
+            uids = self._span_uids(request.uid, request.metadata)
+            if span is not None and len(uids) > 1:
+                span.set("span_len", len(uids))
+            grads = await self._run_backward_span(uids, inputs)
+            serialized = self._serialize_timed(grads)
+        return runtime_pb2.ExpertResponse(tensors=serialized)
 
     async def _run_decode(self, uid: str, metadata: bytes, tensors: List[np.ndarray]) -> np.ndarray:
         meta = MSGPackSerializer.loads(metadata) if metadata else {}
@@ -150,7 +196,11 @@ class ConnectionHandler(ServicerBase):
         uids = self._span_uids(uid, metadata)
         reset = bool(meta.get("reset", False))
         for span_uid in uids:
+            step_start = time.perf_counter()
             x = await self.decode_sessions.decode_async(span_uid, str(session_id), x, reset)
+            # decode bypasses the pools: the whole session step (incl. the
+            # continuous-batching flush window) is the compute phase
+            accrue_span_phase("compute_s", time.perf_counter() - step_start)
         return x
 
     async def rpc_decode(self, request: runtime_pb2.ExpertRequest, context: P2PContext) -> runtime_pb2.ExpertResponse:
@@ -158,31 +208,54 @@ class ConnectionHandler(ServicerBase):
         ``{"session_id": str, "reset": bool}``; sessions bypass the batching
         pools — each holds its own per-client device cache."""
         tensors = [deserialize_tensor(t) for t in request.tensors]
-        output = await self._run_decode(request.uid, request.metadata, tensors)
-        return runtime_pb2.ExpertResponse(tensors=[serialize_tensor(output)])
+        with self._serving_trace("decode", request.uid, context, tensors):
+            output = await self._run_decode(request.uid, request.metadata, tensors)
+            serialized = self._serialize_timed([output])
+        return runtime_pb2.ExpertResponse(tensors=serialized)
+
+    # NOTE on the stream RPCs below: the serving span must not wrap a `yield`
+    # (an async generator's body runs in its consumer's context), so it closes
+    # after compute and the response chunks then serialize LAZILY, one tensor
+    # at a time — a multi-hundred-MB streamed response must never be
+    # materialized whole. Stream kinds therefore carry no `serialize_s` phase.
 
     async def rpc_decode_stream(
         self, requests: AsyncIterator[runtime_pb2.ExpertRequest], context: P2PContext
     ) -> AsyncIterator[runtime_pb2.ExpertResponse]:
         """Streaming variant for prefill chunks over the unary payload cap."""
-        uid, metadata, tensors = await self._collect_stream_with_metadata(requests)
-        output = await self._run_decode(uid, metadata, tensors)
+        with self._serving_trace("decode_stream", "?", context) as span:
+            uid, metadata, tensors = await self._collect_stream_with_metadata(requests)
+            if span is not None:
+                span.set("expert", uid)
+                if tensors and getattr(tensors[0], "ndim", 0):
+                    span.set("batch", int(tensors[0].shape[0]))
+            output = await self._run_decode(uid, metadata, tensors)
         for message in self._stream_response([output]):
             yield message
 
     async def rpc_forward_stream(
         self, requests: AsyncIterator[runtime_pb2.ExpertRequest], context: P2PContext
     ) -> AsyncIterator[runtime_pb2.ExpertResponse]:
-        uid, metadata, tensors = await self._collect_stream_with_metadata(requests)
-        outputs = await self._run_forward_span(self._span_uids(uid, metadata), tensors)
+        with self._serving_trace("forward_stream", "?", context) as span:
+            uid, metadata, tensors = await self._collect_stream_with_metadata(requests)
+            if span is not None:
+                span.set("expert", uid)
+                if tensors and getattr(tensors[0], "ndim", 0):
+                    span.set("batch", int(tensors[0].shape[0]))
+            outputs = await self._run_forward_span(self._span_uids(uid, metadata), tensors)
         for message in self._stream_response(outputs):
             yield message
 
     async def rpc_backward_stream(
         self, requests: AsyncIterator[runtime_pb2.ExpertRequest], context: P2PContext
     ) -> AsyncIterator[runtime_pb2.ExpertResponse]:
-        uid, metadata, tensors = await self._collect_stream_with_metadata(requests)
-        grads = await self._run_backward_span(self._span_uids(uid, metadata), tensors)
+        with self._serving_trace("backward_stream", "?", context) as span:
+            uid, metadata, tensors = await self._collect_stream_with_metadata(requests)
+            if span is not None:
+                span.set("expert", uid)
+                if tensors and getattr(tensors[0], "ndim", 0):
+                    span.set("batch", int(tensors[0].shape[0]))
+            grads = await self._run_backward_span(self._span_uids(uid, metadata), tensors)
         for message in self._stream_response(grads):
             yield message
 
@@ -202,7 +275,10 @@ class ConnectionHandler(ServicerBase):
                 yield list(request.tensors)
 
         tensors = await deserialize_tensor_stream(parts())
-        assert uid is not None, "stream carried no expert uid"
+        if uid is None:
+            # wire input from a remote peer: a proper error the client can read
+            # (an assert would vanish under -O and crash as a bare AssertionError)
+            raise ValueError("streamed expert request carried no expert uid")
         return uid, metadata, tensors
 
     @staticmethod

@@ -39,9 +39,36 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
 from hivemind_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
+
+# KV-cache session saturation (ISSUE 9, docs/observability.md "Serving"): the
+# session table is the serving peer's scarcest resource (each session pins
+# device cache memory) and previously had zero visibility
+_SESSIONS = _TELEMETRY.gauge(
+    "hivemind_moe_decode_sessions", "live KV-cache decode sessions on this server"
+)
+_SESSION_OCCUPANCY = _TELEMETRY.gauge(
+    "hivemind_moe_decode_session_occupancy",
+    "live decode sessions / max_sessions (1.0 = the LRU cap is about to evict)",
+)
+_EVICTIONS = _TELEMETRY.counter(
+    "hivemind_moe_decode_session_evictions_total",
+    "decode sessions evicted, by reason (ttl = idle expiry, cap = LRU over max_sessions)",
+    ("reason",),
+)
+_RESETS = _TELEMETRY.counter(
+    "hivemind_moe_decode_session_resets_total",
+    "decode sessions created or re-prefilled via reset=True",
+)
+_STEPS = _TELEMETRY.counter(
+    "hivemind_moe_decode_steps_total",
+    "decode session steps served, by path (direct = per-session call, "
+    "batched = merged into a vmapped continuous batch)",
+    ("path",),
+)
 
 
 def _next_pow2(n: int) -> int:
@@ -106,11 +133,19 @@ class DecodeSessionManager:
         ]
         for key in expired:
             del self._sessions[key]
+        if expired:
+            _EVICTIONS.inc(len(expired), reason="ttl")
         evictable = [k for k in self._sessions if id(self._sessions[k]) not in pinned]
         while len(self._sessions) > self.max_sessions and evictable:
             oldest = min(evictable, key=lambda k: self._sessions[k].last_used)
             evictable.remove(oldest)
             del self._sessions[oldest]
+            _EVICTIONS.inc(reason="cap")
+        self._sample_gauges_locked()
+
+    def _sample_gauges_locked(self) -> None:
+        _SESSIONS.set(len(self._sessions))
+        _SESSION_OCCUPANCY.set(round(len(self._sessions) / max(self.max_sessions, 1), 4))
 
     def _raw_step(self, uid: str):
         """The un-jitted per-session step; shared by the direct and batched paths so
@@ -158,6 +193,8 @@ class DecodeSessionManager:
                     # that exceeds one chip's HBM still fits the slice
                     cache_k, cache_v = backend.shard_decode_cache(cache_k, cache_v)
                 session = self._sessions[key] = _Session(cache_k, cache_v)
+                _RESETS.inc()
+                self._sample_gauges_locked()
             elif session is None:
                 # NEVER silently prefill a continuation: an evicted/expired/unknown
                 # session would return semantically-garbage activations. The client
@@ -199,6 +236,7 @@ class DecodeSessionManager:
                 session.cache_v, jnp.int32(session.index),
             )
             session.index += new_len
+            _STEPS.inc(path="direct")
             return np.asarray(y)[:, :new_len]
 
     # ---- continuous batching of single-token steps across sessions ------------
@@ -373,6 +411,7 @@ class DecodeSessionManager:
                 jnp.asarray(idxs, jnp.int32),
             )
             y = np.asarray(y)
+            _STEPS.inc(len(live), path="batched")
             now = time.monotonic()
             for row, i in enumerate(live):
                 _future, session, _x = entries[i]

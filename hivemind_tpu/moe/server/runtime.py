@@ -16,8 +16,9 @@ from hivemind_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 # layer-5 telemetry (docs/observability.md): per-pool throughput, batch latency
-# and queue depth — the registry replaces the old private per-Runtime _stats
-# dict, so one scrape sees the same numbers the periodic log line reports
+# and drain-loop utilization — the registry replaces the old private per-Runtime
+# _stats dict, so one scrape sees the same numbers the periodic log line reports
+# (queue depth/age gauges live in task_pool.py, sampled on submit AND drain)
 from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
 
 _BATCHES = _TELEMETRY.counter(
@@ -32,8 +33,10 @@ _BATCH_FAILURES = _TELEMETRY.counter(
 _BATCH_LATENCY = _TELEMETRY.histogram(
     "hivemind_moe_batch_latency_seconds", "device time of one batch", ("pool",)
 )
-_QUEUE_DEPTH = _TELEMETRY.gauge(
-    "hivemind_moe_pool_queue_depth", "tasks waiting in a pool after the last drain", ("pool",)
+_UTILIZATION = _TELEMETRY.gauge(
+    "hivemind_moe_runtime_utilization",
+    "fraction of the drain loop's recent wall time spent processing batches "
+    "(1.0 = the device executor never idles; sampled over ~5 s windows)",
 )
 
 
@@ -43,13 +46,18 @@ class Runtime:
         self.stats_report_interval = stats_report_interval
         self._task: Optional[asyncio.Task] = None
         self._last_report = time.perf_counter()
+        # drain-loop utilization (ISSUE 9): busy seconds over a rolling window —
+        # 1.0 with growing queues means the device executor is the bottleneck;
+        # low utilization with deep queues points at dispatch, not compute
+        self._utilization_window = 5.0
+        self._busy_s = 0.0
+        self._busy_anchor = time.perf_counter()
         # cached metric children: pool names are stable for the Runtime's lifetime
         self._children = {
             pool.name: (
                 _BATCHES.labels(pool.name),
                 _SAMPLES.labels(pool.name),
                 _BATCH_LATENCY.labels(pool.name),
-                _QUEUE_DEPTH.labels(pool.name),
             )
             for pool in self.pools
         }
@@ -60,7 +68,7 @@ class Runtime:
         # work as one giant first interval.
         self._reported: Dict[str, Tuple[float, float, float]] = {
             name: (batches.value, samples.value, latency.sum)
-            for name, (batches, samples, latency, _depth) in self._children.items()
+            for name, (batches, samples, latency) in self._children.items()
         }
 
     def start(self) -> None:
@@ -76,11 +84,11 @@ class Runtime:
                     waiter.cancel()
             pool = min(self.pools, key=lambda p: p.priority)
             if pool.priority == float("inf"):
+                self._account_busy(0.0)  # idle windows drive the gauge to 0
                 await asyncio.sleep(0.001)
                 continue
             batch = pool.pop_batch()
-            batches_c, samples_c, latency_h, depth_g = self._children[pool.name]
-            depth_g.set(pool.queue_size)
+            batches_c, samples_c, latency_h = self._children[pool.name]
             if not batch:
                 continue
             start = time.perf_counter()
@@ -90,12 +98,24 @@ class Runtime:
                 logger.warning(f"pool {pool.name}: batch failed with {e!r}")
                 _BATCH_FAILURES.inc(pool=pool.name)
                 pool.fail_batch(batch, e)
+                self._account_busy(time.perf_counter() - start)
                 continue
             elapsed = time.perf_counter() - start
             batches_c.inc()
             samples_c.inc(sum(t.batch_size for t in batch))
             latency_h.observe(elapsed)
+            self._account_busy(elapsed)
             self._maybe_report_stats()
+
+    def _account_busy(self, elapsed: float) -> None:
+        """Utilization gauge: busy seconds / wall seconds over ~5 s windows."""
+        self._busy_s += elapsed
+        now = time.perf_counter()
+        window = now - self._busy_anchor
+        if window >= self._utilization_window:
+            _UTILIZATION.set(round(min(self._busy_s / window, 1.0), 4))
+            self._busy_s = 0.0
+            self._busy_anchor = now
 
     def _maybe_report_stats(self) -> None:
         """StatsReporter parity (reference runtime.py:161-199): periodic per-pool
@@ -108,7 +128,7 @@ class Runtime:
             return
         self._last_report = now
         for name in sorted(self._children):
-            batches_c, samples_c, latency_h, _depth = self._children[name]
+            batches_c, samples_c, latency_h = self._children[name]
             totals = (batches_c.value, samples_c.value, latency_h.sum)
             last = self._reported.get(name, (0.0, 0.0, 0.0))
             batches, samples, seconds = (t - l for t, l in zip(totals, last))

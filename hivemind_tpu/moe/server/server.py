@@ -42,12 +42,14 @@ class Server:
         checkpoint_dir: Optional[Path] = None,
         decode_max_len: int = 256,
         decode_max_sessions: int = 64,
+        max_queue_size: int = 1024,
         loop_runner: Optional[LoopRunner] = None,
     ):
         self.dht, self.backends = dht, backends
         self.update_period = update_period
         self.handler = ConnectionHandler(
-            backends, decode_max_len=decode_max_len, decode_max_sessions=decode_max_sessions
+            backends, decode_max_len=decode_max_len, decode_max_sessions=decode_max_sessions,
+            max_queue_size=max_queue_size,
         )
         self.runtime = Runtime(self.handler.all_pools())
         self.checkpoint_saver = (
@@ -73,6 +75,8 @@ class Server:
         dht: Optional[DHT] = None,
         checkpoint_dir: Optional[Path] = None,
         decode_max_len: int = 256,
+        decode_max_sessions: int = 64,
+        max_queue_size: int = 1024,
         start: bool = False,
         **backend_kwargs,
     ) -> "Server":
@@ -108,7 +112,8 @@ class Server:
             loaded = load_experts(backends, checkpoint_dir)
             if loaded:
                 logger.info(f"restored {loaded} experts from {checkpoint_dir}")
-        server = cls(dht, backends, checkpoint_dir=checkpoint_dir, decode_max_len=decode_max_len)
+        server = cls(dht, backends, checkpoint_dir=checkpoint_dir, decode_max_len=decode_max_len,
+                     decode_max_sessions=decode_max_sessions, max_queue_size=max_queue_size)
         if start:
             server.run_in_background(await_ready=True)
         return server

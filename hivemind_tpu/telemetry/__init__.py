@@ -16,12 +16,23 @@ distributed tracing with a per-process flight recorder.
   per-peer straggler scores, served at ``GET /ledger``.
 - :mod:`~hivemind_tpu.telemetry.watchdog` — event-loop lag probe with stall
   stack capture and executor-queue-depth gauges.
+- :mod:`~hivemind_tpu.telemetry.serving` — the serving-path attribution layer
+  (ISSUE 9): one record per expert request decomposed into queue-wait /
+  batch-assembly / device-compute / serialize, per-expert quantiles, per-client
+  attribution, plus client-side expert scorecards; served at ``GET /serving``.
 
 See docs/observability.md for the metric catalog and the span catalog.
 """
 
 from hivemind_tpu.telemetry.exporter import MetricsExporter, render_prometheus
 from hivemind_tpu.telemetry.ledger import LEDGER, RoundLedger
+from hivemind_tpu.telemetry.serving import (
+    SCORECARDS,
+    SERVING_LEDGER,
+    ExpertScorecards,
+    ServingLedger,
+    is_overload_error,
+)
 from hivemind_tpu.telemetry.watchdog import (
     EventLoopWatchdog,
     ensure_watchdog,
@@ -60,6 +71,11 @@ __all__ = [
     "RECORDER",
     "LEDGER",
     "RoundLedger",
+    "SERVING_LEDGER",
+    "SCORECARDS",
+    "ServingLedger",
+    "ExpertScorecards",
+    "is_overload_error",
     "EventLoopWatchdog",
     "ensure_watchdog",
     "watchdog_summary",

@@ -16,6 +16,7 @@ from typing import Optional
 
 from hivemind_tpu.telemetry.ledger import LEDGER, RoundLedger
 from hivemind_tpu.telemetry.registry import REGISTRY, MetricsRegistry
+from hivemind_tpu.telemetry.serving import SERVING_LEDGER, ServingLedger
 from hivemind_tpu.telemetry.tracing import RECORDER, SpanRecorder, render_chrome_trace
 from hivemind_tpu.utils.logging import get_logger
 
@@ -78,6 +79,7 @@ class _MetricsHandler(BaseHTTPRequestHandler):
     registry: MetricsRegistry = REGISTRY  # overridden per-server
     recorder: SpanRecorder = RECORDER  # overridden per-server
     ledger: RoundLedger = LEDGER  # overridden per-server
+    serving_ledger: ServingLedger = SERVING_LEDGER  # overridden per-server
 
     def do_GET(self):  # noqa: N802 (stdlib API)
         path = self.path.split("?", 1)[0]
@@ -94,6 +96,14 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             # "where did epoch N's wall time go, and which peer caused it" —
             # serialization happens HERE, never on the record path
             body = json.dumps(self.ledger.export(), default=str).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+        elif path == "/serving":
+            # per-request serving attribution (ISSUE 9): records with their
+            # queue-wait/assembly/compute/serialize decomposition, per-expert
+            # quantiles, per-client attribution, slowest exemplars, the live
+            # saturation gauges, and this process's client-side scorecards
+            body = json.dumps(self.serving_ledger.export(), default=str).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
         elif path == "/trace":
@@ -122,8 +132,9 @@ class _MetricsHandler(BaseHTTPRequestHandler):
 class MetricsExporter:
     """Serve ``/metrics`` (Prometheus text), ``/metrics.json`` (compact
     snapshot), ``/trace`` (Chrome trace-event JSON from the span flight
-    recorder), ``/ledger`` (raw per-round attribution records) and
-    ``/healthz`` on a daemon thread.
+    recorder), ``/ledger`` (raw per-round attribution records), ``/serving``
+    (raw per-request serving attribution + scorecards) and ``/healthz`` on a
+    daemon thread.
 
     :param port: TCP port; 0 picks a free one (read it back via ``.port``)
     :param host: bind host; default loopback — pass "0.0.0.0" for remote scrapers
@@ -136,15 +147,18 @@ class MetricsExporter:
         registry: MetricsRegistry = REGISTRY,
         recorder: SpanRecorder = RECORDER,
         ledger: RoundLedger = LEDGER,
+        serving_ledger: ServingLedger = SERVING_LEDGER,
         start: bool = True,
     ):
         self.registry = registry
         self.recorder = recorder
         self.ledger = ledger
+        self.serving_ledger = serving_ledger
         handler = type(
             "_BoundMetricsHandler",
             (_MetricsHandler,),
-            {"registry": registry, "recorder": recorder, "ledger": ledger},
+            {"registry": registry, "recorder": recorder, "ledger": ledger,
+             "serving_ledger": serving_ledger},
         )
         self._server = ThreadingHTTPServer((host, port), handler)
         self._server.daemon_threads = True

@@ -69,6 +69,17 @@ def build_peer_snapshot(
     ledger = LEDGER.snapshot()
     if ledger:
         snapshot["ledger"] = ledger
+    # serving attribution (ISSUE 9): per-expert serving stats + saturation on
+    # the server side, expert scorecards on the client side — hivemind-top's
+    # --serving board renders entirely from this section
+    from hivemind_tpu.telemetry.serving import SCORECARDS, SERVING_LEDGER
+
+    serving = SERVING_LEDGER.snapshot()
+    scorecards = SCORECARDS.snapshot()
+    if scorecards:
+        serving["scorecards"] = scorecards
+    if serving:
+        snapshot["serving"] = serving
     watchdog = watchdog_summary()
     if watchdog.get("loops"):
         snapshot["watchdog"] = watchdog
@@ -92,7 +103,17 @@ def _shrink_to_fit(snapshot: Dict[str, Any], max_bytes: int = _MAX_SNAPSHOT_BYTE
         if len(MSGPackSerializer.dumps(candidate)) <= max_bytes:
             return candidate
         snapshot = candidate
-    for optional_key in ("recent_spans", "slow_spans", "ledger"):
+    # serving records shrink before they drop: the per-expert stats + totals
+    # are the board's load-bearing part, the slowest exemplars are context
+    serving = snapshot.get("serving")
+    if isinstance(serving, dict) and ("slowest" in serving or "clients" in serving):
+        shrunk_serving = {k: v for k, v in serving.items() if k not in ("slowest", "clients")}
+        candidate = {**snapshot, "serving": shrunk_serving, "truncated": True}
+        if len(MSGPackSerializer.dumps(candidate)) <= max_bytes:
+            return candidate
+        snapshot = candidate
+    # span summaries are nice-to-have context: they go first
+    for optional_key in ("recent_spans", "slow_spans"):
         if optional_key in snapshot:
             snapshot = {k: v for k, v in snapshot.items() if k != optional_key}
             snapshot["truncated"] = True
@@ -101,13 +122,22 @@ def _shrink_to_fit(snapshot: Dict[str, Any], max_bytes: int = _MAX_SNAPSHOT_BYTE
     metrics = dict(snapshot.get("metrics", {}))
     # per-label series are the bulk; the swarm view only ever aggregates a
     # family's totals, so COMPACT the largest families to one summed series
-    # before dropping anything — every family stays visible swarm-wide
+    # BEFORE dropping the attribution sections — a label explosion must cost
+    # label detail (recoverable swarm-wide), not the ledger/serving records
+    # (irreplaceable; ISSUE 9 made this ordering explicit)
     by_size = sorted(metrics, key=lambda name: -len(str(metrics[name])))
     for name in by_size:
         metrics[name] = _compact_family(metrics[name])
         shrunk = {**snapshot, "metrics": metrics, "truncated": True}
         if len(MSGPackSerializer.dumps(shrunk)) <= max_bytes:
             return shrunk
+    snapshot = {**snapshot, "metrics": metrics}
+    for optional_key in ("serving", "ledger"):
+        if optional_key in snapshot:
+            snapshot = {k: v for k, v in snapshot.items() if k != optional_key}
+            snapshot["truncated"] = True
+            if len(MSGPackSerializer.dumps(snapshot)) <= max_bytes:
+                return snapshot
     # still too big (pathological family count): drop largest families outright
     for name in sorted(metrics, key=lambda name: -len(str(metrics[name]))):
         metrics.pop(name)
@@ -348,7 +378,7 @@ class SwarmMonitor:
                 # numbers below are a snapshot of the PAST, not the present
                 marker = " STALE" + marker
             printable = {
-                k: v for k, v in health.items() if k not in ("ledger", "watchdog")
+                k: v for k, v in health.items() if k not in ("ledger", "watchdog", "serving")
             }
             lines.append(f"  peer {peer[:16]}…:{marker} {printable}")
             for board, state in sorted(breakers.items()):
@@ -368,9 +398,60 @@ class SwarmMonitor:
                     f"    straggler seen: {str(victim)[:16]} slowest in "
                     f"{score.get('rounds_slowest', 0)} round(s), +{score.get('excess_s', 0.0)}s excess"
                 )
+        serving_board = self.render_serving_board(view)
+        if serving_board:
+            lines.append(serving_board)
         timeline = self.render_epoch_timeline(view)
         if timeline:
             lines.append(timeline)
+        return "\n".join(lines)
+
+    def render_serving_board(self, view: Optional[Dict[str, Any]] = None) -> str:
+        """The serving board (ISSUE 9): per-expert request counts / p95 / sheds
+        merged across every peer's serving section, the saturation gauges
+        (queue depth/age, session occupancy, shed totals), degraded client-side
+        scorecards, and the slowest-request exemplars — which expert on which
+        peer is eating serving time, as one screen. Parsing is shared with
+        ``hivemind-top --serving`` (telemetry.serving.collect_swarm_serving)."""
+        from hivemind_tpu.telemetry.serving import (
+            collect_swarm_serving,
+            format_saturation_parts,
+            format_scorecard_line,
+            format_slowest_line,
+        )
+
+        view = view if view is not None else self.poll()
+        data = collect_swarm_serving(view.get("peers") or {})
+        if not any(data[key] for key in ("experts", "saturation", "degraded_scorecards", "slowest", "malformed")):
+            return ""
+        lines = ["  serving board (expert @ peer / requests / p95 / sheds):"]
+        for peer, uid, stats in data["experts"][:16]:
+            p95 = stats["p95_s"]
+            lines.append(
+                f"    {uid[:24]:<24} @ {peer[:12]:<12} {stats['requests']:>6.0f} req "
+                f"p95={f'{p95 * 1e3:.1f}ms' if p95 is not None else '-':>9}"
+                + (f"  SHED x{stats['sheds']}" if stats["sheds"] else "")
+            )
+        for peer in data["malformed"]:
+            lines.append(f"    {peer[:16]:<16} <malformed serving section>")
+        if data["saturation"]:
+            lines.append("  serving saturation:")
+            lines.extend(
+                f"    {peer[:16]:<16} {', '.join(format_saturation_parts(entry))}"
+                for peer, entry in data["saturation"]
+            )
+        if data["degraded_scorecards"]:
+            lines.append("  degraded expert scorecards (client view):")
+            lines.extend(
+                "    " + format_scorecard_line(peer, uid, card)
+                for peer, uid, card in data["degraded_scorecards"][:8]
+            )
+        if data["slowest"]:
+            lines.append("  slowest requests:")
+            lines.extend(
+                "    " + format_slowest_line(total_s, peer, record)
+                for total_s, peer, record in data["slowest"][:5]
+            )
         return "\n".join(lines)
 
     def render_epoch_timeline(self, view: Optional[Dict[str, Any]] = None) -> str:

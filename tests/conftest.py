@@ -48,6 +48,7 @@ def cleanup_children():
     from hivemind_tpu.resilience import CHAOS, reset_all_boards
     from hivemind_tpu.telemetry import watchdog as telemetry_watchdog
     from hivemind_tpu.telemetry.ledger import LEDGER
+    from hivemind_tpu.telemetry.serving import SCORECARDS, SERVING_LEDGER
     from hivemind_tpu.telemetry.tracing import RECORDER
     from hivemind_tpu.utils.crypto import Ed25519PrivateKey
 
@@ -56,6 +57,8 @@ def cleanup_children():
     RECORDER.clear()  # one test's spans must not satisfy another's assertions
     RECORDER.slow_threshold = float(os.environ.get("HIVEMIND_SLOW_SPAN_S", "10.0"))
     LEDGER.clear()  # one test's round records must not satisfy another's assertions
+    SERVING_LEDGER.clear()  # serving records + expert scorecards likewise
+    SCORECARDS.clear()
     telemetry_watchdog.shutdown_all()  # watchdog threads re-arm with the next loop owner
     Ed25519PrivateKey.reset_process_wide()
     gc.collect()

@@ -201,3 +201,35 @@ def test_bench_artifact_embeds_ledger_and_watchdog_attribution():
     full = json.loads(err.getvalue())
     assert full["telemetry"]["attribution"]["ledger"]["stragglers"]["peerX"]["rounds_slowest"] == 7
     assert "attribution" not in full["extra"]["averaging_extra"]
+
+
+def test_bench_artifact_embeds_serving_attribution():
+    """ISSUE 9: the llama-serving swarm's per-request attribution summary rides
+    the BENCH artifact under telemetry.serving — per-expert p50/p95, phase
+    decomposition, batch occupancy, shed count."""
+    serving = {
+        "value": 19.0,
+        "extra": {
+            "serving": {
+                "requests": 98, "errors": 0, "sheds": 0,
+                "phases": {
+                    "total_s": {"mean": 0.05, "p50": 0.04, "p95": 0.11},
+                    "compute_s": {"mean": 0.03, "p50": 0.03, "p95": 0.06},
+                },
+                "batch_occupancy": {"mean": 0.002, "p50": 0.002, "p95": 0.002},
+                "experts": {"lb.0": {"requests": 49, "p95_s": 0.06, "p50_s": 0.04}},
+            },
+        },
+    }
+    section = bench.telemetry_section(None, serving)
+    assert section["serving"]["requests"] == 98
+    assert section["serving"]["experts"]["lb.0"]["p95_s"] == 0.06
+
+    result = _bloated_result()
+    result["telemetry"] = section
+    out, err = io.StringIO(), io.StringIO()
+    bench.emit(result, out=out, err=err)
+    full = json.loads(err.getvalue())
+    assert full["telemetry"]["serving"]["phases"]["compute_s"]["p95"] == 0.06
+    # missing serving stays absent, never a crash
+    assert "serving" not in bench.telemetry_section(None, None)

@@ -1,0 +1,41 @@
+"""Wires tools/check_metric_docs.py into the suite (ISSUE 9 satellite): a
+registered ``hivemind_*`` metric missing from docs/observability.md's catalog
+fails tier-1 (the catalog already drifted once — a queue-depth gauge documented
+under a wrong name)."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+import check_metric_docs
+
+
+def test_every_registered_metric_is_documented():
+    failures, warnings = check_metric_docs.check()
+    assert not failures, (
+        "metric-catalog violations (see tools/check_metric_docs.py):\n"
+        + "\n".join(failures)
+    )
+    for warning in warnings:
+        print(f"note: {warning}")
+
+
+def test_lint_catches_undocumented_and_stale_names(tmp_path):
+    """The lint actually detects (a) a registered-but-undocumented metric and
+    (b) a documented-but-unregistered catalog row."""
+    package = tmp_path / "pkg"
+    package.mkdir()
+    (package / "mod.py").write_text(
+        'REGISTRY.counter("hivemind_phantom_total", "doc", ("x",))\n'
+        'REGISTRY.gauge("hivemind_documented_gauge", "doc")\n'
+    )
+    doc = tmp_path / "observability.md"
+    doc.write_text(
+        "| `hivemind_documented_gauge` | gauge | — | fine |\n"
+        "| `hivemind_stale_rows_total` | counter | — | registered nowhere |\n"
+    )
+    failures, warnings = check_metric_docs.check(package_root=package, doc_path=doc)
+    assert any("hivemind_phantom_total" in failure for failure in failures), failures
+    assert not any("hivemind_documented_gauge" in failure for failure in failures)
+    assert any("hivemind_stale_rows_total" in warning for warning in warnings), warnings

@@ -1,0 +1,138 @@
+#!/usr/bin/env python3
+"""Lint: every registered ``hivemind_*`` metric must be documented (ISSUE 9).
+
+docs/observability.md is the operator's metric catalog, and it already drifted
+once (the queue-depth gauge was documented under a wrong name). This lint keeps
+the catalog honest by construction:
+
+1. **AST scan** — every ``*.counter("hivemind_...")`` / ``.gauge(...)`` /
+   ``.histogram(...)`` call in the tree whose first argument is a string
+   literal starting with ``hivemind_`` registers a metric name. A non-literal
+   first argument to one of those methods is a violation too (dynamic metric
+   names cannot be cataloged).
+2. **Catalog check** — each registered name must appear verbatim somewhere in
+   docs/observability.md. Missing names fail the suite.
+3. **Stale-entry sweep** — names that look like metrics in the doc's catalog
+   tables (``| `hivemind_...` |`` rows) but are registered nowhere are
+   reported as warnings so the catalog shrinks with the code.
+
+Run directly (``python tools/check_metric_docs.py``) or via
+``tests/test_metric_docs_lint.py`` (tier-1).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+from typing import Dict, List, Set, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PACKAGE_ROOT = REPO_ROOT / "hivemind_tpu"
+DOC_PATH = REPO_ROOT / "docs" / "observability.md"
+
+_REGISTER_METHODS = {"counter", "gauge", "histogram"}
+_DOC_TABLE_NAME = re.compile(r"^\|\s*`(hivemind_[a-z0-9_]+)`")
+
+# documented names that are rendered, not registered (the exporter appends
+# _total to counters / _bucket/_sum/_count to histograms at scrape time)
+_RENDERED_SUFFIXES = ("_total", "_bucket", "_sum", "_count")
+
+
+def registered_metrics(
+    package_root: Path = PACKAGE_ROOT,
+) -> Tuple[Dict[str, List[str]], List[str]]:
+    """Returns ({metric_name: [file:line, ...]}, [dynamic-name violations])."""
+    names: Dict[str, List[str]] = {}
+    dynamic: List[str] = []
+    for path in sorted(package_root.rglob("*.py")):
+        relpath = str(path.relative_to(package_root.parent))
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _REGISTER_METHODS
+                and node.args
+            ):
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(first.value, str):
+                if first.value.startswith("hivemind_"):
+                    names.setdefault(first.value, []).append(f"{relpath}:{node.lineno}")
+            elif isinstance(first, ast.Constant):
+                continue  # literal non-string: not a metric registration
+            else:
+                # .counter(variable) — could be re-declaring an existing family
+                # (watchdog re-registers by passing <metric>.documentation); only
+                # flag when the call LOOKS like a registry registration, i.e.
+                # the receiver is named like a registry
+                receiver = node.func.value
+                receiver_name = getattr(receiver, "id", getattr(receiver, "attr", ""))
+                if str(receiver_name).lower().endswith(("registry", "telemetry")) or (
+                    str(receiver_name) == "REGISTRY"
+                ):
+                    dynamic.append(
+                        f"{relpath}:{node.lineno} — dynamic metric name in "
+                        f".{node.func.attr}(...): metric names must be string "
+                        f"literals so the catalog lint can see them"
+                    )
+    return names, dynamic
+
+
+def documented_names(doc_path: Path = DOC_PATH) -> Tuple[str, Set[str]]:
+    """Returns (full doc text, names that appear as catalog-table rows)."""
+    text = doc_path.read_text()
+    table_names = {
+        match.group(1)
+        for line in text.splitlines()
+        for match in [_DOC_TABLE_NAME.match(line.strip())]
+        if match is not None
+    }
+    return text, table_names
+
+
+def check(
+    package_root: Path = PACKAGE_ROOT, doc_path: Path = DOC_PATH
+) -> Tuple[List[str], List[str]]:
+    """Returns (failures, warnings) as printable strings."""
+    names, dynamic = registered_metrics(package_root)
+    doc_text, table_names = documented_names(doc_path)
+    failures = list(dynamic)
+    for name, sites in sorted(names.items()):
+        if name not in doc_text:
+            failures.append(
+                f"metric {name!r} (registered at {', '.join(sites[:3])}) is not in "
+                f"docs/observability.md — add it to the catalog"
+            )
+    warnings = []
+    registered = set(names)
+    for name in sorted(table_names):
+        candidates = {name} | {
+            name[: -len(suffix)] for suffix in _RENDERED_SUFFIXES if name.endswith(suffix)
+        }
+        if not candidates & registered:
+            warnings.append(
+                f"docs/observability.md catalogs {name!r} but nothing registers it "
+                f"(stale entry or typo'd name — the drift this lint exists to catch)"
+            )
+    return failures, warnings
+
+
+def main() -> int:
+    failures, warnings = check()
+    for warning in warnings:
+        print(f"warning: {warning}")
+    if failures:
+        print(f"{len(failures)} metric-catalog violation(s):")
+        for failure in failures:
+            print(f"  {failure}")
+        return 1
+    names, _dynamic = registered_metrics()
+    print(f"ok: all {len(names)} registered hivemind_* metrics are documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
