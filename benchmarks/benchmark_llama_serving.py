@@ -62,11 +62,23 @@ def main():
     parser.add_argument("--prompt", type=int, default=16)
     parser.add_argument("--generate", type=int, default=48)
     parser.add_argument("--decode_max_len", type=int, default=128)
+    parser.add_argument("--activation_compression", default="float16",
+                        help="serving wire dtype for the A/B ('none' = "
+                             "bit-identical fp32 wire; see docs/benchmarks.md)")
+    parser.add_argument("--smoke", action="store_true",
+                        help="tier-1-safe regression mode: tiny model, exits "
+                             "nonzero if any request fails or the serving "
+                             "wire-bytes counters did not move (wired into "
+                             "tests so serving data-path breakage fails loudly)")
     from hivemind_tpu.utils.platform import add_platform_arg, apply_platform
 
     add_platform_arg(parser)
     args = parser.parse_args()
     apply_platform(args)
+    if args.smoke:
+        args.hidden_dim, args.num_heads, args.num_kv_heads = 64, 4, 4
+        args.inner, args.layers = 128, 1
+        args.prompt, args.generate = 4, 4
 
     from hivemind_tpu.dht import DHT
     from hivemind_tpu.moe import RemoteSequential
@@ -105,7 +117,8 @@ def main():
         )
 
         dht = DHT(start=True)
-        server = Server(dht, backends, decode_max_len=args.decode_max_len)
+        server = Server(dht, backends, decode_max_len=args.decode_max_len,
+                        activation_compression=args.activation_compression)
         client_dht = None
         try:
             server.run_in_background(await_ready=True)
@@ -118,17 +131,49 @@ def main():
             pipe.decode_step(hidden[:, : args.prompt], "warm", reset=True)  # compile
             pipe.decode_step(hidden[:, args.prompt : args.prompt + 1], "warm")
 
+            # wire accounting (ISSUE 10): serving payload bytes over the timed
+            # window, client side only (the server's mirror totals would double
+            # count this in-process A/B) — bytes-per-token is the headline the
+            # fp16 wire dtype halves vs fp32
+            from hivemind_tpu.telemetry import REGISTRY
+            from hivemind_tpu.telemetry.serving import SERVING_LEDGER
+
+            def client_wire_bytes():
+                out = {}
+                for name, field in (("hivemind_moe_bytes_sent_total", "sent"),
+                                    ("hivemind_moe_bytes_received_total", "received")):
+                    metric = REGISTRY.get(name)
+                    if metric is not None:
+                        out[field] = metric.labels("client").value
+                return out
+
+            wire_before = client_wire_bytes()
             start = time.perf_counter()
             pipe.decode_step(hidden[:, : args.prompt], "bench", reset=True)
             for t in range(args.generate):
                 pos = args.prompt + t
-                pipe.decode_step(hidden[:, pos : pos + 1], "bench")
+                try:
+                    pipe.decode_step(hidden[:, pos : pos + 1], "bench")
+                except Exception as e:
+                    # ANY failed request voids the run: a tok/s computed over
+                    # partially-failed steps would record an inflated A/B
+                    raise SystemExit(f"decode step {t} failed (run void): {e!r}")
             elapsed = time.perf_counter() - start
+            wire_after = client_wire_bytes()
+            wire_delta = {
+                key: wire_after.get(key, 0.0) - wire_before.get(key, 0.0)
+                for key in wire_after
+            }
+            # per generated token, each way (the prefill rides the first step)
+            wire_per_token = {
+                key: round(value / max(args.generate, 1), 1)
+                for key, value in wire_delta.items()
+            }
+            if args.smoke and not all(wire_delta.get(k, 0) > 0 for k in ("sent", "received")):
+                raise SystemExit(f"smoke mode: serving wire-bytes counters did not move: {wire_delta}")
             # serving attribution rides the artifact (ISSUE 9): the server ran
             # in-process, so the global ledger holds every request's phase
             # decomposition — bench.py lands this under telemetry.serving
-            from hivemind_tpu.telemetry.serving import SERVING_LEDGER
-
             print(json.dumps({
                 "metric": "llama_checkpoint_decode",
                 "value": round(args.generate / elapsed, 1),
@@ -147,6 +192,11 @@ def main():
                     "planned_blocks_16gb_8sessions": plan_16gb,
                     "prompt": args.prompt, "generated": args.generate,
                     "prefill_included_tok_s": round((args.prompt + args.generate) / elapsed, 1),
+                    "activation_compression": args.activation_compression,
+                    "smoke": args.smoke,
+                    # client-side serving payload bytes over the timed window,
+                    # per generated token (the fp16-vs-fp32 wire A/B headline)
+                    "wire_bytes_per_token": wire_per_token,
                     "serving": SERVING_LEDGER.summary(),
                 },
             }))

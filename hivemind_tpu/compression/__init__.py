@@ -17,10 +17,15 @@ from hivemind_tpu.compression.quantization import (
     Uniform8BitQuantization,
 )
 from hivemind_tpu.compression.serialization import (
+    codec_name,
     deserialize_tensor,
     deserialize_tensor_stream,
     deserialize_to_jax,
+    expert_request_parts,
+    expert_response_parts,
     get_codec,
+    resolve_activation_codec,
     serialize_tensor,
+    split_response_for_wire,
     split_tensor_for_streaming,
 )

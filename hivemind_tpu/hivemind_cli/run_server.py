@@ -36,6 +36,12 @@ def main():
                              "waiting tasks are SHED with ServerOverloadedError "
                              "(counted in hivemind_moe_shed_total) instead of "
                              "queueing unboundedly toward client timeouts")
+    parser.add_argument("--activation_compression", default="float16",
+                        help="wire dtype for expert activations/grads on the "
+                             "serving RPC path (float16 halves wire bytes; "
+                             "'none' = bit-identical fp32). Published in expert "
+                             "info + DHT declarations so clients negotiate the "
+                             "same codec for requests; see docs/benchmarks.md")
     parser.add_argument("--custom_module_path", default=None,
                         help="path to a .py file whose @register_expert_class "
                              "decorators run before the server starts (capability "
@@ -134,6 +140,7 @@ def main():
         decode_max_len=args.decode_max_len,
         decode_max_sessions=args.decode_max_sessions,
         max_queue_size=args.max_queue_size,
+        activation_compression=args.activation_compression,
         optim_factory=lambda: optax.adam(args.learning_rate),
         start=True,
     )
@@ -222,6 +229,7 @@ def _serve_llama_checkpoint(args) -> Server:
         # session manager to it so the reservation is real, not advisory
         decode_max_sessions=args.decode_sessions_budget,
         max_queue_size=args.max_queue_size,
+        activation_compression=args.activation_compression,
     )
     server.run_in_background(await_ready=True)
     return server

@@ -110,9 +110,10 @@ class MoEBeamSearcher:
         for beam in beams:
             sample_result = []
             for neg_score, uid in sorted(beam):
-                peer_id = uid_to_peer.get(uid)
-                if peer_id is not None:
-                    sample_result.append(ExpertInfo(uid, peer_id))
+                resolved = uid_to_peer.get(uid)
+                if resolved is not None:
+                    peer_id, compression = resolved
+                    sample_result.append(ExpertInfo(uid, peer_id, compression))
             results.append(sample_result)
         return results
 
@@ -135,18 +136,20 @@ class MoEBeamSearcher:
                 self._negative_cache.store(prefix, True, get_dht_time() + self.negative_cache_time)
         return out
 
-    async def _resolve_leaves(self, node, uids: List[str]) -> Dict[str, PeerID]:
+    async def _resolve_leaves(self, node, uids: List[str]):
+        """uid -> (peer_id, advertised activation compression or None); the
+        record may be a bare peer id or ``peer|compression`` (dht_handler)."""
+        from hivemind_tpu.moe.server.dht_handler import parse_expert_record
+
         if not uids:
             return {}
         found = await node.get_many(uids)
         out = {}
         for uid in uids:
             entry = found.get(uid)
-            if entry is not None and isinstance(entry.value, str):
-                try:
-                    out[uid] = PeerID.from_base58(entry.value)
-                except Exception:
-                    continue
+            parsed = parse_expert_record(entry.value) if entry is not None else None
+            if parsed is not None:
+                out[uid] = parsed
         return out
 
     def get_initial_beam(self, dim_scores: np.ndarray, beam_size: int):

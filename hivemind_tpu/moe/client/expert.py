@@ -17,11 +17,23 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from hivemind_tpu.compression import deserialize_tensor, serialize_tensor, split_tensor_for_streaming
+from hivemind_tpu.compression import (
+    CompressionBase,
+    deserialize_tensor,
+    expert_request_parts,
+    resolve_activation_codec,
+    serialize_tensor,
+    split_tensor_for_streaming,
+)
 from hivemind_tpu.moe.expert_uid import IDEMPOTENT_CONNECTION_RPCS, ExpertInfo
 from hivemind_tpu.p2p import P2P, PeerID
 from hivemind_tpu.proto import runtime_pb2
-from hivemind_tpu.telemetry.serving import SCORECARDS, is_overload_error
+from hivemind_tpu.telemetry.serving import (
+    SCORECARDS,
+    WIRE_BYTES_RECEIVED,
+    WIRE_BYTES_SENT,
+    is_overload_error,
+)
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.loop import LoopRunner, get_loop_runner
 from hivemind_tpu.utils.serializer import MSGPackSerializer
@@ -29,6 +41,11 @@ from hivemind_tpu.utils.serializer import MSGPackSerializer
 logger = get_logger(__name__)
 
 MAX_UNARY_PAYLOAD_SIZE = 2 * 1024 * 1024  # parity: p2p_daemon_bindings/control.py:36-39
+_OFF_LOOP_CODEC_BYTES = 256 * 1024  # payloads past this compress/decompress in the executor
+
+# serving wire accounting, this process as the CALLER (docs/observability.md)
+_CLIENT_BYTES_SENT = WIRE_BYTES_SENT.labels("client")
+_CLIENT_BYTES_RECEIVED = WIRE_BYTES_RECEIVED.labels("client")
 
 
 class RemoteExpertWorker:
@@ -44,10 +61,15 @@ class RemoteExpertWorker:
 class RemoteExpert:
     """A callable handle to a remote expert; differentiable via custom_vjp."""
 
-    def __init__(self, expert_info: ExpertInfo, p2p: P2P):
+    def __init__(self, expert_info: ExpertInfo, p2p: P2P,
+                 request_compression: Optional[str] = None):
         self.expert_info = expert_info
         self.p2p = p2p
         self.span: Optional[List[str]] = None  # see _span_metadata
+        # wire-dtype override for requests; None = negotiate the server's
+        # advertised codec (DHT declaration, else rpc_info; "none" fallback
+        # keeps pre-negotiation servers bit-identical)
+        self.request_compression = request_compression
         self._info: Optional[Dict[str, Any]] = None
         self._info_lock = threading.Lock()
 
@@ -63,18 +85,53 @@ class RemoteExpert:
     def info(self) -> Dict[str, Any]:
         """Forward/output schemas fetched lazily via rpc_info (reference expert.py)."""
         with self._info_lock:
+            if self._info is not None:
+                return self._info
+        info = RemoteExpertWorker.run_coroutine(self._fetch_info())
+        return info
+
+    async def _fetch_info(self) -> Dict[str, Any]:
+        """Async twin of :attr:`info` (usable ON the RPC loop — the sync property
+        would deadlock there)."""
+        with self._info_lock:
+            if self._info is not None:
+                return self._info
+        response = await self.p2p.call_protobuf_handler(
+            self.peer_id,
+            "ConnectionHandler.rpc_info",
+            runtime_pb2.ExpertUID(uid=self.uid),
+            runtime_pb2.ExpertInfoResponse,
+            idempotent=True,
+        )
+        info = MSGPackSerializer.loads(response.serialized_info)
+        with self._info_lock:
             if self._info is None:
-                response = RemoteExpertWorker.run_coroutine(
-                    self.p2p.call_protobuf_handler(
-                        self.peer_id,
-                        "ConnectionHandler.rpc_info",
-                        runtime_pb2.ExpertUID(uid=self.uid),
-                        runtime_pb2.ExpertInfoResponse,
-                        idempotent=True,
-                    )
-                )
-                self._info = MSGPackSerializer.loads(response.serialized_info)
+                self._info = info
             return self._info
+
+    async def _wire_codec(self) -> CompressionBase:
+        """The negotiated request wire dtype (ISSUE 10): an explicit
+        ``request_compression`` override wins; otherwise the server's advertised
+        codec — from its DHT declaration when present (zero extra round-trips),
+        else from ``rpc_info`` (fetched once, cached with the schemas). Servers
+        that advertise nothing get bit-identical NONE."""
+        if self.request_compression is not None:
+            return resolve_activation_codec(self.request_compression)
+        name: Optional[str] = None
+        with self._info_lock:
+            if self._info is not None:
+                name = self._info.get("activation_compression") or "none"
+        if name is None:
+            name = self.expert_info.compression
+        if name is None:
+            info = await self._fetch_info()
+            name = info.get("activation_compression") or "none"
+        try:
+            return resolve_activation_codec(name)
+        except ValueError:
+            # a newer server advertising a codec this build lacks: stay correct
+            logger.warning(f"expert {self.uid}: unknown advertised compression {name!r}; using none")
+            return resolve_activation_codec("none")
 
     # ------------------------------------------------------------------ raw RPC
 
@@ -107,16 +164,55 @@ class RemoteExpert:
     async def _call_inner(
         self, method: str, tensors: Sequence[np.ndarray], metadata: bytes = b""
     ) -> List[np.ndarray]:
-        serialized = [serialize_tensor(np.asarray(t, np.float32)) for t in tensors]
-        payload = sum(len(s.buffer) for s in serialized)
+        codec = await self._wire_codec()
+
+        def _serialize_all() -> List[runtime_pb2.Tensor]:
+            # astype(copy=False): an fp32 input serializes as a VIEW (the old
+            # np.asarray(t, np.float32) spelling forced the same cast but reads
+            # as a copy; the explicit copy= keeps the hot-path lint honest); the
+            # codec owns any further conversion and must NOT write into
+            # caller-owned memory (no allow_inplace here)
+            return [
+                serialize_tensor(np.asarray(t).astype(np.float32, copy=False), codec)
+                for t in tensors
+            ]
+
+        # big payloads compress off the shared client loop (the same loop runs
+        # the DHT and every concurrent expert fan-out); small ones inline — the
+        # executor hop would dominate a 4 KB decode step
+        if sum(getattr(t, "nbytes", 0) for t in tensors) >= _OFF_LOOP_CODEC_BYTES:
+            from hivemind_tpu.utils.asyncio_utils import run_in_executor
+
+            serialized = await run_in_executor(_serialize_all)
+        else:
+            serialized = _serialize_all()
+        # unary/stream decision on the fp32-EQUIVALENT size, not the compressed
+        # bytes: a NONE server answers an fp16 request with a response ~2x the
+        # request, and a unary response must stay under the mux frame cap
+        payload = sum(int(np.asarray(t).size) * 4 for t in tensors)
         if payload <= MAX_UNARY_PAYLOAD_SIZE:
+            # spliced scatter-gather request: tensor buffers ride to the AEAD
+            # uncopied instead of being re-materialized by SerializeToString
+            request = expert_request_parts(self.uid, serialized, metadata)
             response = await self.p2p.call_protobuf_handler(
                 self.peer_id,
                 f"ConnectionHandler.rpc_{method}",
-                runtime_pb2.ExpertRequest(uid=self.uid, tensors=serialized, metadata=metadata),
+                request,
                 runtime_pb2.ExpertResponse,
                 idempotent=(f"rpc_{method}" in IDEMPOTENT_CONNECTION_RPCS),
             )
+            # counted AFTER the round-trip: a shed/dead-peer attempt must not
+            # drift client-sent above server-received (retries count once, like
+            # the server's parsed-request accounting)
+            _CLIENT_BYTES_SENT.inc(request.nbytes)
+            received = response.ByteSize()
+            _CLIENT_BYTES_RECEIVED.inc(received)
+            if received >= _OFF_LOOP_CODEC_BYTES:
+                from hivemind_tpu.utils.asyncio_utils import run_in_executor
+
+                return await run_in_executor(
+                    lambda: [deserialize_tensor(t) for t in response.tensors]
+                )
             return [deserialize_tensor(t) for t in response.tensors]
         # streaming path for big payloads (metadata rides the first message)
 
@@ -124,10 +220,12 @@ class RemoteExpert:
             first = True
             for tensor in serialized:
                 for chunk in split_tensor_for_streaming(tensor, 2**20):
-                    yield runtime_pb2.ExpertRequest(
+                    message = runtime_pb2.ExpertRequest(
                         uid=self.uid if first else "", tensors=[chunk],
                         metadata=metadata if first else b"",
                     )
+                    _CLIENT_BYTES_SENT.inc(message.ByteSize())
+                    yield message
                     first = False
 
         from hivemind_tpu.compression import deserialize_tensor_stream
@@ -138,9 +236,12 @@ class RemoteExpert:
 
         async def parts():
             async for response in stream:
+                _CLIENT_BYTES_RECEIVED.inc(response.ByteSize())
                 yield list(response.tensors)
 
-        return await deserialize_tensor_stream(parts())
+        # off_loop: this is by definition the multi-MB path, and the client
+        # loop is shared with the DHT and every concurrent expert fan-out
+        return await deserialize_tensor_stream(parts(), off_loop=True)
 
     def forward_np(self, *xs: np.ndarray) -> List[np.ndarray]:
         return RemoteExpertWorker.run_coroutine(
@@ -237,7 +338,9 @@ class RemoteExpert:
                 *residual_xs,
                 *grads_out,
             )
-            return tuple(g_in.astype(x.dtype) for g_in, x in zip(grads_in, residual_xs))
+            return tuple(
+                g_in.astype(x.dtype, copy=False) for g_in, x in zip(grads_in, residual_xs)
+            )
 
         remote_call.defvjp(fwd, bwd)
         return remote_call(*xs)

@@ -37,7 +37,8 @@ class _ResilientBlock(RemoteExpert):
     pass of jax.grad and jitted execution, not just the eager forward dispatch."""
 
     def __init__(self, sequential: "RemoteSequential", index: int, info: ExpertInfo):
-        super().__init__(info, sequential.p2p)
+        super().__init__(info, sequential.p2p,
+                         request_compression=sequential.request_compression)
         self._sequential = sequential
         self._index = index
 
@@ -87,9 +88,13 @@ class RemoteSequential:
         update_period: float = 30.0,
         max_retries: int = 2,
         max_failover_history: int = 4096,
+        request_compression: Optional[str] = None,
     ):
         self.dht, self.prefix, self.num_blocks = dht, prefix, num_blocks
         self.update_period, self.max_retries = update_period, max_retries
+        # wire-dtype override for every block request; None = negotiate each
+        # server's advertised codec (ISSUE 10 — see docs/benchmarks.md)
+        self.request_compression = request_compression
         # decode failover retains each session's input history for re-prefill; the
         # cap bounds client memory (past it, failover degrades to the pre-r4
         # raise-and-reset behavior for that session). 0 disables retention.
@@ -182,7 +187,8 @@ class RemoteSequential:
         """Resolve blocks [start, stop) and group CONSECUTIVE same-peer blocks into
         spans: each group is one RPC (server chains the blocks — span execution)."""
         blocks = [
-            RemoteExpert(self._resolve_info(index, force=force), self.p2p)
+            RemoteExpert(self._resolve_info(index, force=force), self.p2p,
+                         request_compression=self.request_compression)
             for index in range(start, stop)
         ]
         groups = []

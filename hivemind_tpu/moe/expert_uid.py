@@ -6,7 +6,7 @@ grid dimension. Beam search walks prefixes left to right."""
 from __future__ import annotations
 
 import re
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 from hivemind_tpu.p2p import PeerID
 
@@ -45,3 +45,7 @@ def split_uid(uid_or_prefix: str) -> Tuple[ExpertPrefix, int]:
 class ExpertInfo(NamedTuple):
     uid: ExpertUID
     peer_id: PeerID
+    # the server's advertised wire dtype for activations ("float16", "none", …)
+    # when its DHT declaration carried one; None = unknown (the client falls
+    # back to the rpc_info negotiation on first use)
+    compression: Optional[str] = None

@@ -5,9 +5,28 @@ here one asyncio servicer feeding the task pools directly).
 Serving attribution (ISSUE 9): every expert RPC runs inside a ``serving.request``
 span — a child of the ``p2p.handle:`` span, which already joined the remote
 caller's trace via cross-peer propagation, so the request's phase decomposition
-(queue-wait / batch-assembly / device-compute stamped by the TaskPool, serialize
+(queue-wait / batch-assembly / compute stamped by the TaskPool, serialize
 stamped here) lands in the CALLER's trace and in the process-wide
-:data:`~hivemind_tpu.telemetry.serving.SERVING_LEDGER`."""
+:data:`~hivemind_tpu.telemetry.serving.SERVING_LEDGER`.
+
+Serving data path (ISSUE 10, the PR 5 playbook applied to this layer):
+
+- **Wire dtype**: responses are serialized with this server's configured
+  activation codec (``--activation_compression``; default fp16, ``none`` =
+  bit-identical). The choice is published in ``rpc_info`` (and on the DHT via
+  the expert declarations), so clients negotiate the same dtype for requests.
+- **Off-loop codecs**: request deserialization and response serialization run
+  on the shared executor past a small inline threshold — the event-loop
+  watchdog proved inline codecs stall RPC dispatch under load (the evidence
+  was multi-MB payloads; a ~4 KB decode step stays inline, where the executor
+  hop would dominate). The ``serialize_s`` phase accrues the executor
+  round-trip when off-loop (queue time included; see docs/observability.md).
+- **Scatter-gather responses**: responses leave as spliced
+  :class:`~hivemind_tpu.utils.streaming.WireParts` frames — the tensor buffer
+  rides into the AEAD as its own buffer instead of being copied into one
+  ``SerializeToString`` blob; stream chunks are zero-copy memoryview slices,
+  still serialized lazily one tensor at a time.
+"""
 
 from __future__ import annotations
 
@@ -18,24 +37,44 @@ import numpy as np
 
 from hivemind_tpu.compression import (
     CompressionType,
+    codec_name,
     deserialize_tensor,
     deserialize_tensor_stream,
+    expert_response_parts,
+    resolve_activation_codec,
     serialize_tensor,
-    split_tensor_for_streaming,
+    split_response_for_wire,
 )
 from hivemind_tpu.moe.expert_uid import IDEMPOTENT_CONNECTION_RPCS
 from hivemind_tpu.moe.server.module_backend import ModuleBackend
 from hivemind_tpu.moe.server.task_pool import TaskPool
 from hivemind_tpu.p2p import P2P, P2PContext, ServicerBase
 from hivemind_tpu.proto import runtime_pb2
-from hivemind_tpu.telemetry.serving import SERVING_SPAN, accrue_span_phase
+from hivemind_tpu.telemetry.serving import (
+    SERVING_SPAN,
+    WIRE_BYTES_RECEIVED,
+    WIRE_BYTES_SENT,
+    accrue_span_phase,
+)
 from hivemind_tpu.telemetry.tracing import trace as _trace
+from hivemind_tpu.utils.asyncio_utils import run_in_executor
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.serializer import MSGPackSerializer
+from hivemind_tpu.utils.streaming import WireParts
 
 logger = get_logger(__name__)
 
 _STREAM_CHUNK = 2**20  # 1 MiB chunks inside stream replies
+
+# payloads below this encode/decode inline: the executor hop would dominate a
+# ~4 KB decode step (same rationale and threshold as the client's
+# _OFF_LOOP_CODEC_BYTES in moe/client/expert.py — the loop-stall evidence that
+# motivated off-loop codecs came from MULTI-MB payloads)
+_OFF_LOOP_CODEC_BYTES = 256 * 1024
+
+# cached metric children (one label value per role on this path)
+_SERVER_BYTES_SENT = WIRE_BYTES_SENT.labels("server")
+_SERVER_BYTES_RECEIVED = WIRE_BYTES_RECEIVED.labels("server")
 
 
 class ConnectionHandler(ServicerBase):
@@ -44,10 +83,12 @@ class ConnectionHandler(ServicerBase):
     _idempotent_rpcs = IDEMPOTENT_CONNECTION_RPCS
 
     def __init__(self, backends: Dict[str, ModuleBackend], decode_max_len: int = 256,
-                 decode_max_sessions: int = 64, max_queue_size: int = 1024):
+                 decode_max_sessions: int = 64, max_queue_size: int = 1024,
+                 activation_compression: str = "float16"):
         from hivemind_tpu.moe.server.decode_session import DecodeSessionManager
 
         self.backends = backends
+        self.activation_codec = resolve_activation_codec(activation_compression)
         self.forward_pools: Dict[str, TaskPool] = {}
         self.backward_pools: Dict[str, TaskPool] = {}
         self.decode_sessions = DecodeSessionManager(
@@ -62,6 +103,11 @@ class ConnectionHandler(ServicerBase):
                 backend.backward, f"{name}_backward", max_batch_size=backend.max_batch_size,
                 max_queue_size=max_queue_size,
             )
+
+    @property
+    def activation_compression(self) -> str:
+        """Canonical knob value of this server's wire dtype ("float16", "none", …)."""
+        return codec_name(self.activation_codec)
 
     def all_pools(self) -> List[TaskPool]:
         return list(self.forward_pools.values()) + list(self.backward_pools.values())
@@ -91,6 +137,9 @@ class ConnectionHandler(ServicerBase):
             raise KeyError(f"unknown expert {request.uid!r}")
         info = backend.get_info()
         info["span_support"] = True  # clients only group co-located blocks if set
+        # wire-dtype negotiation (ISSUE 10): clients serialize their request
+        # activations with the server's declared codec (NONE stays bit-identical)
+        info["activation_compression"] = self.activation_compression
         if self.decode_sessions.supports(request.uid):
             info["decode_max_len"] = self.decode_sessions.max_len
         return runtime_pb2.ExpertInfoResponse(serialized_info=MSGPackSerializer.dumps(info))
@@ -155,34 +204,58 @@ class ConnectionHandler(ServicerBase):
             grads = await self._run_backward(span_uid, [*inputs, *grads])
         return grads
 
-    @staticmethod
-    def _serialize_timed(outputs: List[np.ndarray]) -> List:
-        """Serialize the response tensors, accruing the serialize phase onto the
-        active serving span (the fourth slice of the request decomposition)."""
+    # ------------------------------------------------------------------ codecs
+
+    async def _deserialize_request(self, tensors) -> List[np.ndarray]:
+        """Parse request tensors; big payloads decode off the event loop (the
+        watchdog showed inline deserialization stalling dispatch under load),
+        small ones inline (the executor hop would dominate them)."""
+        if not tensors:
+            return []
+        tensor_list = list(tensors)
+        if sum(len(t.buffer) for t in tensor_list) < _OFF_LOOP_CODEC_BYTES:
+            return [deserialize_tensor(t) for t in tensor_list]
+        return await run_in_executor(lambda: [deserialize_tensor(t) for t in tensor_list])
+
+    def _serialize_outputs(self, outputs: List[np.ndarray]) -> List[runtime_pb2.Tensor]:
+        # allow_inplace: each output row range is private to its task (views of
+        # the fresh device-transfer batch), so the fp16 clip may reuse it
+        return [serialize_tensor(o, self.activation_codec, None, True) for o in outputs]
+
+    async def _respond(self, outputs: List[np.ndarray]) -> WireParts:
+        """Serialize the response with the server's wire dtype (off-loop past
+        the inline threshold), accrue the serialize phase onto the active
+        serving span, and frame the tensors scatter-gather (buffers uncopied
+        to the AEAD)."""
         start = time.perf_counter()
-        serialized = [serialize_tensor(o) for o in outputs]
+        if sum(int(getattr(o, "nbytes", 0)) for o in outputs) < _OFF_LOOP_CODEC_BYTES:
+            serialized = self._serialize_outputs(outputs)
+        else:
+            serialized = await run_in_executor(self._serialize_outputs, outputs)
         accrue_span_phase("serialize_s", time.perf_counter() - start)
-        return serialized
+        response = expert_response_parts(serialized)
+        _SERVER_BYTES_SENT.inc(response.nbytes)
+        return response
 
     async def rpc_forward(self, request: runtime_pb2.ExpertRequest, context: P2PContext) -> runtime_pb2.ExpertResponse:
-        inputs = [deserialize_tensor(t) for t in request.tensors]
+        _SERVER_BYTES_RECEIVED.inc(request.ByteSize())
+        inputs = await self._deserialize_request(request.tensors)
         with self._serving_trace("forward", request.uid, context, inputs) as span:
             uids = self._span_uids(request.uid, request.metadata)
             if span is not None and len(uids) > 1:
                 span.set("span_len", len(uids))
             outputs = await self._run_forward_span(uids, inputs)
-            serialized = self._serialize_timed(outputs)
-        return runtime_pb2.ExpertResponse(tensors=serialized)
+            return await self._respond(outputs)
 
     async def rpc_backward(self, request: runtime_pb2.ExpertRequest, context: P2PContext) -> runtime_pb2.ExpertResponse:
-        inputs = [deserialize_tensor(t) for t in request.tensors]
+        _SERVER_BYTES_RECEIVED.inc(request.ByteSize())
+        inputs = await self._deserialize_request(request.tensors)
         with self._serving_trace("backward", request.uid, context, inputs) as span:
             uids = self._span_uids(request.uid, request.metadata)
             if span is not None and len(uids) > 1:
                 span.set("span_len", len(uids))
             grads = await self._run_backward_span(uids, inputs)
-            serialized = self._serialize_timed(grads)
-        return runtime_pb2.ExpertResponse(tensors=serialized)
+            return await self._respond(grads)
 
     async def _run_decode(self, uid: str, metadata: bytes, tensors: List[np.ndarray]) -> np.ndarray:
         meta = MSGPackSerializer.loads(metadata) if metadata else {}
@@ -207,11 +280,11 @@ class ConnectionHandler(ServicerBase):
         """One KV-cache session step (decode_session.py). Metadata carries
         ``{"session_id": str, "reset": bool}``; sessions bypass the batching
         pools — each holds its own per-client device cache."""
-        tensors = [deserialize_tensor(t) for t in request.tensors]
+        _SERVER_BYTES_RECEIVED.inc(request.ByteSize())
+        tensors = await self._deserialize_request(request.tensors)
         with self._serving_trace("decode", request.uid, context, tensors):
             output = await self._run_decode(request.uid, request.metadata, tensors)
-            serialized = self._serialize_timed([output])
-        return runtime_pb2.ExpertResponse(tensors=serialized)
+            return await self._respond([output])
 
     # NOTE on the stream RPCs below: the serving span must not wrap a `yield`
     # (an async generator's body runs in its consumer's context), so it closes
@@ -230,7 +303,7 @@ class ConnectionHandler(ServicerBase):
                 if tensors and getattr(tensors[0], "ndim", 0):
                     span.set("batch", int(tensors[0].shape[0]))
             output = await self._run_decode(uid, metadata, tensors)
-        for message in self._stream_response([output]):
+        async for message in self._stream_response([output]):
             yield message
 
     async def rpc_forward_stream(
@@ -243,7 +316,7 @@ class ConnectionHandler(ServicerBase):
                 if tensors and getattr(tensors[0], "ndim", 0):
                     span.set("batch", int(tensors[0].shape[0]))
             outputs = await self._run_forward_span(self._span_uids(uid, metadata), tensors)
-        for message in self._stream_response(outputs):
+        async for message in self._stream_response(outputs):
             yield message
 
     async def rpc_backward_stream(
@@ -256,34 +329,44 @@ class ConnectionHandler(ServicerBase):
                 if tensors and getattr(tensors[0], "ndim", 0):
                     span.set("batch", int(tensors[0].shape[0]))
             grads = await self._run_backward_span(self._span_uids(uid, metadata), tensors)
-        for message in self._stream_response(grads):
+        async for message in self._stream_response(grads):
             yield message
 
-    @staticmethod
-    async def _collect_stream_with_metadata(requests: AsyncIterator[runtime_pb2.ExpertRequest]):
-        """Collect a streamed request: uid + first message's metadata + tensors."""
+    async def _collect_stream_with_metadata(self, requests: AsyncIterator[runtime_pb2.ExpertRequest]):
+        """Collect a streamed request: uid + first message's metadata + tensors.
+        Chunk reassembly/deserialization runs off-loop (one tensor at a time,
+        as the chunks arrive)."""
         uid = None
         metadata = b""
 
         async def parts():
             nonlocal uid, metadata
             async for request in requests:
+                _SERVER_BYTES_RECEIVED.inc(request.ByteSize())
                 if uid is None and request.uid:
                     uid = request.uid
                 if not metadata and request.metadata:
                     metadata = request.metadata
                 yield list(request.tensors)
 
-        tensors = await deserialize_tensor_stream(parts())
+        tensors = await deserialize_tensor_stream(parts(), off_loop=True)
         if uid is None:
             # wire input from a remote peer: a proper error the client can read
             # (an assert would vanish under -O and crash as a bare AssertionError)
             raise ValueError("streamed expert request carried no expert uid")
         return uid, metadata, tensors
 
-    @staticmethod
-    def _stream_response(outputs: List[np.ndarray]):
+    async def _stream_response(self, outputs: List[np.ndarray]):
+        """Lazy streamed response: each tensor serializes off-loop (with the
+        server's wire dtype) only when its turn comes, and its chunks are
+        zero-copy memoryview slices framed scatter-gather."""
         for out in outputs:
-            serialized = serialize_tensor(out)
-            for chunk in split_tensor_for_streaming(serialized, _STREAM_CHUNK):
-                yield runtime_pb2.ExpertResponse(tensors=[chunk])
+            if int(getattr(out, "nbytes", 0)) < _OFF_LOOP_CODEC_BYTES:
+                serialized = serialize_tensor(out, self.activation_codec, None, True)
+            else:
+                serialized = await run_in_executor(
+                    serialize_tensor, out, self.activation_codec, None, True
+                )
+            for chunk in split_response_for_wire(serialized, _STREAM_CHUNK):
+                _SERVER_BYTES_SENT.inc(chunk.nbytes)
+                yield chunk

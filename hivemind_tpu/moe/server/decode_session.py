@@ -33,7 +33,7 @@ import asyncio
 import os
 import threading
 import time
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -97,10 +97,14 @@ class DecodeSessionManager:
     """
 
     def __init__(self, backends, max_len: int = 256, session_ttl: float = 600.0,
-                 max_sessions: int = 64, flush_window: float = 0.002):
+                 max_sessions: int = 64, flush_window: float = 0.002,
+                 merge_recency_s: Optional[float] = None):
         self.backends = backends
         self.max_len, self.session_ttl, self.max_sessions = max_len, session_ttl, max_sessions
         self.flush_window = flush_window  # how long a drainer waits for stragglers
+        if merge_recency_s is None:
+            merge_recency_s = float(os.environ.get("HIVEMIND_TPU_MERGE_RECENCY_S", "0.25"))
+        self.merge_recency_s = merge_recency_s
         self._sessions: Dict[Tuple[str, str], _Session] = {}
         self._step_fns: Dict[Tuple[str, int, int], callable] = {}
         self._batched_fns: Dict[Tuple[str, int], callable] = {}
@@ -253,6 +257,14 @@ class DecodeSessionManager:
         )
         if not batchable:
             return await loop.run_in_executor(None, self.decode, uid, session_id, x, reset)
+        with self._lock:
+            concurrent = self._concurrent_sessions(uid)
+        if not concurrent:
+            # single actively-decoding stream: the drainer/future/flush-window
+            # machinery has nothing to merge and costs ~ms per token — take the
+            # direct per-session path (same jitted step; same-session ordering
+            # is still serialized by the session lock). ISSUE 10.
+            return await loop.run_in_executor(None, self.decode, uid, session_id, x, reset)
 
         future = loop.create_future()
         with self._lock:
@@ -272,10 +284,43 @@ class DecodeSessionManager:
                 self._drainers[uid] = loop.create_task(self._drain(uid))
         return await future
 
+    # NOTE on merge_recency_s (set in __init__; HIVEMIND_TPU_MERGE_RECENCY_S):
+    # another session counts as a merge candidate only if it stepped within
+    # this window — an actively decoding stream touches its session every
+    # token (tens of ms on one serving hop), while an abandoned session would
+    # otherwise tax every single-stream token with the full flush window until
+    # TTL eviction. Tradeoff: in a DEEP pipeline each server sees a session
+    # once per pipeline round, so with few concurrent streams and a round time
+    # past this window, steps route direct and never merge — raise the env var
+    # there (a rising `path="direct"` share of hivemind_moe_decode_steps_total
+    # under concurrent load is the telltale).
+
+    def _concurrent_sessions(self, uid: str) -> bool:
+        """True when MORE THAN ONE recently-active session exists on this uid
+        (so waiting the flush window could actually merge steps). Called under
+        self._lock; the caller's own session is always recent."""
+        now = time.monotonic()
+        count = 0
+        for key, session in self._sessions.items():
+            if key[0] == uid and now - session.last_used < self.merge_recency_s:
+                count += 1
+                if count > 1:
+                    return True
+        return False
+
     async def _drain(self, uid: str) -> None:
         loop = asyncio.get_running_loop()
         try:
-            await asyncio.sleep(self.flush_window)  # let concurrent streams pile up
+            # the flush window exists to merge OTHER clients' concurrent steps;
+            # with a single actively-decoding session per uid it is pure
+            # per-token latency (2 ms/step measured) — skip straight to the
+            # drain (ISSUE 10)
+            with self._lock:
+                window = self.flush_window if self._concurrent_sessions(uid) else 0.0
+            if window:
+                await asyncio.sleep(window)  # let concurrent streams pile up
+            else:
+                await asyncio.sleep(0)  # one loop tick: same-tick submitters still merge
         except asyncio.CancelledError:
             # cancelled before the entries were even popped (server stop during the
             # flush window): no pins were taken yet, but the pending futures would
@@ -390,6 +435,26 @@ class DecodeSessionManager:
                 else:
                     live.append(i)
             if not live:
+                return results
+            if len(live) == 1:
+                # single-stream batch (one client decoding): the vmapped path
+                # would stack-copy the session's multi-hundred-KB caches and
+                # discard dummy-row work per token — use the per-session jitted
+                # step directly (shared with decode(), so signatures can't
+                # diverge); ISSUE 10 copy-free batching applied to decode
+                [i] = live
+                _future, session, x = entries[i]
+                step = self._step_fn(uid, 1, 1)
+                y, session.cache_k, session.cache_v = step(
+                    backend.snapshot_params(), jnp.asarray(x), session.cache_k,
+                    session.cache_v, jnp.int32(session.index),
+                )
+                session.index += 1
+                session.last_used = time.monotonic()
+                # counted "direct": nothing was merged/vmapped (the catalog row
+                # defines `batched` as merged into a vmapped continuous batch)
+                _STEPS.inc(path="direct")
+                results[i] = np.asarray(y)[:, :1]
                 return results
             stack = _next_pow2(len(live))
             dummy_k, dummy_v = self._dummy_rows(uid)

@@ -1,11 +1,19 @@
 """Expert discovery records (capability parity: reference
 hivemind/moe/server/dht_handler.py:22-108): an expert's UID and EVERY prefix of it are
 stored as dictionary subkeys, which is what makes left-to-right beam search over the
-grid possible."""
+grid possible.
+
+Record format: the stored value is ``<peer_b58>`` or ``<peer_b58>|<compression>``
+— servers append their advertised activation wire dtype (ISSUE 10) so clients
+learn the negotiated codec from discovery alone, without an extra ``rpc_info``
+round-trip. Readers in THIS tree accept both forms, so upgraded clients resolve
+legacy servers fine; the reverse is not true — a pre-ISSUE-10 client cannot
+parse the suffixed record (its ``from_base58`` raises and the expert is skipped),
+so serving peers must not upgrade ahead of the clients they serve."""
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from hivemind_tpu.dht import DHT
 from hivemind_tpu.moe.expert_uid import (
@@ -19,14 +27,37 @@ from hivemind_tpu.moe.expert_uid import (
 from hivemind_tpu.p2p import PeerID
 from hivemind_tpu.utils.timed_storage import DHTExpiration, get_dht_time
 
+_RECORD_DELIMITER = "|"
+
+
+def make_expert_record(peer_b58: str, compression: Optional[str] = None) -> str:
+    """The stored declaration value; compression rides after a ``|``."""
+    return f"{peer_b58}{_RECORD_DELIMITER}{compression}" if compression else peer_b58
+
+
+def parse_expert_record(value) -> Optional[Tuple[PeerID, Optional[str]]]:
+    """``(peer_id, compression_or_None)`` from a declaration value, or None if
+    the value is malformed (DHT records are remote-supplied)."""
+    if not isinstance(value, str):
+        return None
+    peer_b58, _, compression = value.partition(_RECORD_DELIMITER)
+    try:
+        return PeerID.from_base58(peer_b58), (compression or None)
+    except Exception:
+        return None
+
 
 def declare_experts(
-    dht: DHT, uids: Sequence[ExpertUID], expiration_time: Optional[DHTExpiration] = None, wait: bool = True
+    dht: DHT,
+    uids: Sequence[ExpertUID],
+    expiration_time: Optional[DHTExpiration] = None,
+    wait: bool = True,
+    compression: Optional[str] = None,
 ):
     """Store this peer's experts: for 'ffn.5.12' store subkey 5 under 'ffn.' and
     subkey 12 under 'ffn.5.' plus the leaf record."""
     expiration_time = expiration_time if expiration_time is not None else get_dht_time() + 300
-    peer_b58 = dht.peer_id.to_base58()
+    record = make_expert_record(dht.peer_id.to_base58(), compression)
 
     async def _declare(dht_obj, node):
         keys, values, subkeys, expirations = [], [], [], []
@@ -34,14 +65,14 @@ def declare_experts(
             assert is_valid_uid(uid), f"invalid expert uid {uid!r}"
             keys.append(uid)
             subkeys.append(None)
-            values.append(peer_b58)
+            values.append(record)
             expirations.append(expiration_time)
             prefix = uid
             while True:
                 prefix, coord = split_uid(prefix)
                 keys.append(prefix.rstrip(UID_DELIMITER))
                 subkeys.append(coord)
-                values.append(peer_b58)
+                values.append(record)
                 expirations.append(expiration_time)
                 if UID_DELIMITER not in prefix.rstrip(UID_DELIMITER):
                     break  # reached the grid root (e.g. 'ffn_test')
@@ -54,20 +85,20 @@ def declare_experts(
 def get_experts(
     dht: DHT, uids: Sequence[ExpertUID], expiration_time: Optional[DHTExpiration] = None, wait: bool = True
 ):
-    """Resolve expert UIDs to ExpertInfo(uid, peer_id) (or None if not found)."""
+    """Resolve expert UIDs to ExpertInfo(uid, peer_id, compression) (or None if
+    not found)."""
 
     async def _get(dht_obj, node) -> List[Optional[ExpertInfo]]:
         found = await node.get_many(list(uids))
         out: List[Optional[ExpertInfo]] = []
         for uid in uids:
             entry = found.get(uid)
-            if entry is None or not isinstance(entry.value, str):
+            parsed = parse_expert_record(entry.value) if entry is not None else None
+            if parsed is None:
                 out.append(None)
                 continue
-            try:
-                out.append(ExpertInfo(uid, PeerID.from_base58(entry.value)))
-            except Exception:
-                out.append(None)
+            peer_id, compression = parsed
+            out.append(ExpertInfo(uid, peer_id, compression))
         return out
 
     return dht.run_coroutine(_get, return_future=not wait)

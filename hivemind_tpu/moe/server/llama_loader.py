@@ -338,11 +338,16 @@ def generate_greedy(
 
     if session_id is None:
         session_id = f"gen-{uuid.uuid4().hex}"
-    ids = np.asarray(prompt_ids, np.int64)
-    hidden = pipe.decode_step(head.embed(ids), session_id, reset=True)
+    prompt = np.asarray(prompt_ids, np.int64)
+    # preallocate the full id buffer once: the old per-token
+    # np.concatenate([ids, next_ids]) recopied the whole history every step,
+    # making generation O(len²) in tokens (ISSUE 10 satellite)
+    ids = np.empty((prompt.shape[0], prompt.shape[1] + max_new_tokens), np.int64)
+    ids[:, : prompt.shape[1]] = prompt
+    hidden = pipe.decode_step(head.embed(prompt), session_id, reset=True)
     for step in range(max_new_tokens):
         next_ids = np.argmax(head.logits(np.asarray(hidden)[:, -1:]), axis=-1)
-        ids = np.concatenate([ids, next_ids], axis=1)
+        ids[:, prompt.shape[1] + step] = next_ids[:, 0]
         if step + 1 < max_new_tokens:
             hidden = pipe.decode_step(head.embed(next_ids), session_id)
     return ids

@@ -43,13 +43,14 @@ class Server:
         decode_max_len: int = 256,
         decode_max_sessions: int = 64,
         max_queue_size: int = 1024,
+        activation_compression: str = "float16",
         loop_runner: Optional[LoopRunner] = None,
     ):
         self.dht, self.backends = dht, backends
         self.update_period = update_period
         self.handler = ConnectionHandler(
             backends, decode_max_len=decode_max_len, decode_max_sessions=decode_max_sessions,
-            max_queue_size=max_queue_size,
+            max_queue_size=max_queue_size, activation_compression=activation_compression,
         )
         self.runtime = Runtime(self.handler.all_pools())
         self.checkpoint_saver = (
@@ -77,6 +78,7 @@ class Server:
         decode_max_len: int = 256,
         decode_max_sessions: int = 64,
         max_queue_size: int = 1024,
+        activation_compression: str = "float16",
         start: bool = False,
         **backend_kwargs,
     ) -> "Server":
@@ -113,7 +115,8 @@ class Server:
             if loaded:
                 logger.info(f"restored {loaded} experts from {checkpoint_dir}")
         server = cls(dht, backends, checkpoint_dir=checkpoint_dir, decode_max_len=decode_max_len,
-                     decode_max_sessions=decode_max_sessions, max_queue_size=max_queue_size)
+                     decode_max_sessions=decode_max_sessions, max_queue_size=max_queue_size,
+                     activation_compression=activation_compression)
         if start:
             server.run_in_background(await_ready=True)
         return server
@@ -145,6 +148,9 @@ class Server:
                     self.dht, list(self.backends.keys()),
                     expiration_time=get_dht_time() + self.update_period * 3,
                     wait=False,
+                    # the declaration carries the wire dtype, so clients learn
+                    # the negotiated codec from discovery alone (ISSUE 10)
+                    compression=self.handler.activation_compression,
                 )
             await asyncio.sleep(self.update_period)
 

@@ -117,6 +117,9 @@ class TaskPool:
         # deque: submit appends right, drain pops left — O(1) per task where the
         # old list.pop(0) was O(n) under load; priority still reads [0] (oldest)
         self._queue: Deque[_Task] = deque()
+        # reused batch-assembly buffers, keyed (arg index, bucket, trailing
+        # shape, dtype) — see _batch_buffer
+        self._batch_buffers: dict = {}
         self._task_added: Optional[asyncio.Event] = None
         # cached metric children (pool names are stable for the pool's lifetime)
         self._depth_gauge = _QUEUE_DEPTH.labels(name)
@@ -215,28 +218,75 @@ class TaskPool:
     async def wait_for_tasks(self) -> None:
         await self._event().wait()
 
+    def _batch_buffer(self, arg_index: int, bucket: int, sample: np.ndarray) -> np.ndarray:
+        """The reusable batch-assembly buffer for one argument position at one
+        power-of-two bucket size (ISSUE 10: the per-batch ``np.concatenate``
+        allocated + copied every batch; now tasks write once into a buffer that
+        matches the backend's one-executable-per-bucket jit cache, so the
+        backend's own pad-to-bucket step becomes a no-op). Safe to reuse:
+        batches run one at a time on the Runtime's executor, and process_func
+        copies to device before the next batch overwrites it."""
+        key = (arg_index, bucket, sample.shape[1:], sample.dtype.str)
+        buffer = self._batch_buffers.get(key)
+        if buffer is None:
+            if len(self._batch_buffers) >= 32:
+                # trailing shapes are request-controlled (e.g. per-client seq
+                # lengths): bound retention — these are pure caches, so a clear
+                # only costs the next batches one allocation each
+                self._batch_buffers.clear()
+            buffer = self._batch_buffers[key] = np.zeros(
+                (bucket, *sample.shape[1:]), sample.dtype
+            )
+        return buffer
+
     def process_batch(self, tasks: List[_Task]) -> None:
-        """Run process_func on the concatenated batch; split outputs per task.
-        Called from the Runtime's executor thread via call_soon_threadsafe plumbing."""
+        """Run process_func on the assembled batch; split outputs per task as
+        zero-copy views. Called from the Runtime's executor thread via
+        call_soon_threadsafe plumbing."""
+        from hivemind_tpu.moe.server.module_backend import bucket_batch_size
+
         num_args = len(tasks[0].args)
         assembly_start = time.perf_counter()
-        joined = [np.concatenate([t.args[i] for t in tasks], axis=0) for i in range(num_args)]
+        total = sum(t.batch_size for t in tasks)
+        if len(tasks) == 1:
+            # single-task batch (the per-token decode/forward common case):
+            # pass the task's own arrays straight through — zero copies here
+            joined: List[np.ndarray] = list(tasks[0].args)
+            batch_len = total
+        else:
+            # copy-free batching: one write per task into the reused bucket
+            # buffer (vs concatenate-allocate + the backend's pad copy)
+            batch_len = bucket_batch_size(total, self.max_batch_size)
+            joined = []
+            for i in range(num_args):
+                buffer = self._batch_buffer(i, batch_len, tasks[0].args[i])
+                offset = 0
+                for task in tasks:
+                    buffer[offset : offset + task.batch_size] = task.args[i]
+                    offset += task.batch_size
+                if offset < batch_len:
+                    # stale rows from the previous batch must not leak into the
+                    # padding (a backward pool's optimizer update sums over them)
+                    buffer[offset:batch_len] = 0
+                joined.append(buffer)
         compute_start = time.perf_counter()
         outputs = self.process_func(*joined)
         compute_end = time.perf_counter()
         if isinstance(outputs, np.ndarray):
             outputs = [outputs]
-        total = sum(t.batch_size for t in tasks)
         # a process_func returning the wrong leading dim used to mis-slice:
         # some tasks silently received truncated/empty outputs — fail the whole
-        # batch loudly instead (the Runtime routes this into fail_batch)
+        # batch loudly instead (the Runtime routes this into fail_batch).
+        # Outputs must cover the submitted batch; bucket-padded rows beyond
+        # `total` are sliced away below and never reach a task.
         for index, out in enumerate(outputs):
             out_len = np.asarray(out).shape[0] if np.ndim(out) else 0
-            if out_len != total:
+            if out_len not in (total, batch_len):
                 raise ValueError(
                     f"pool {self.name!r}: process_func output {index} has leading "
                     f"dim {out_len} but the batch holds {total} samples "
-                    f"({len(tasks)} tasks) — refusing to mis-slice per-task outputs"
+                    f"({len(tasks)} tasks, padded to {batch_len}) — refusing to "
+                    f"mis-slice per-task outputs"
                 )
         assembly_s = compute_start - assembly_start
         compute_s = compute_end - compute_start

@@ -92,15 +92,19 @@ class MuxStream:
     def peer_id(self):
         return self._conn.peer_id
 
-    async def send(self, message: bytes) -> None:
+    async def send(self, message: bytes, *extra: bytes) -> None:
+        """Send one message; ``extra`` buffers travel scatter-gather with it as a
+        single frame (a spliced protobuf's tensor buffers ride uncopied into the
+        AEAD — the serving-path analog of the averaging framing)."""
         if self._send_closed or self._reset:
             raise StreamClosedError(f"stream {self.stream_id} is closed for sending")
-        if len(message) > MAX_MESSAGE_SIZE:
+        total = len(message) + sum(len(part) for part in extra)
+        if total > MAX_MESSAGE_SIZE:
             raise ValueError(
-                f"message of {len(message)} bytes exceeds MAX_MESSAGE_SIZE={MAX_MESSAGE_SIZE}; "
+                f"message of {total} bytes exceeds MAX_MESSAGE_SIZE={MAX_MESSAGE_SIZE}; "
                 f"split large tensors with utils.streaming.split_for_streaming"
             )
-        await self._conn.send_frame(self.stream_id, Flags.DATA, message)
+        await self._conn.send_frame(self.stream_id, Flags.DATA, message, *extra)
 
     async def send_error(self, exc: BaseException) -> None:
         if self._send_closed or self._reset:

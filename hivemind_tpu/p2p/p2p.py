@@ -45,6 +45,7 @@ from hivemind_tpu.resilience import CHAOS as _CHAOS
 from hivemind_tpu.resilience import Deadline
 from hivemind_tpu.utils.crypto import Ed25519PrivateKey
 from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.streaming import WireParts
 
 logger = get_logger(__name__)
 
@@ -111,11 +112,29 @@ def _parse(message_bytes: bytes, message_type: Optional[Type]):
     return message
 
 
-def _serialize(message) -> bytes:
+def _serialize(message):
     # memoryview included: raw handlers may echo the zero-copy wire view back
+    if isinstance(message, WireParts):
+        return message  # scatter-gather: parts ride uncopied into the frame
     if isinstance(message, (bytes, bytearray, memoryview)):
         return bytes(message)
     return message.SerializeToString()
+
+
+async def _send_payload(stream, payload) -> int:
+    """Send one serialized payload (bytes or WireParts) on a stream; returns the
+    byte count for the RPC accounting."""
+    if isinstance(payload, WireParts):
+        await stream.send(b"", *payload.parts)
+        return payload.nbytes
+    await stream.send(payload)
+    return len(payload)
+
+
+def _chaos_payload(payload):
+    """Chaos corruption operates on materialized bytes; WireParts join only on
+    this (test-only) path."""
+    return payload.join() if isinstance(payload, WireParts) else payload
 
 
 class P2P:
@@ -781,14 +800,10 @@ class P2P:
                 if asyncio.iscoroutine(result):
                     result = await result
                 async for response in result:
-                    payload = _serialize(response)
-                    bytes_out += len(payload)
-                    await stream.send(payload)
+                    bytes_out += await _send_payload(stream, _serialize(response))
             else:
                 response = await handler.fn(request, context)
-                payload = _serialize(response)
-                bytes_out += len(payload)
-                await stream.send(payload)
+                bytes_out += await _send_payload(stream, _serialize(response))
             await stream.close_send()
         except StreamClosedError:
             return  # peer reset/vanished mid-call: normal termination for a handler
@@ -856,14 +871,16 @@ class P2P:
         with _trace(f"p2p.call:{name}", peer=str(self.peer_id), remote=str(peer_id)) as call_span:
             try:
                 if _CHAOS.enabled:  # injection point: drop/delay/corrupt the outbound request
-                    payload = await _CHAOS.inject("p2p.unary.send", payload=payload, scope=str(self.peer_id))
+                    payload = await _CHAOS.inject(
+                        "p2p.unary.send", payload=_chaos_payload(payload), scope=str(self.peer_id)
+                    )
                 for attempt in range(2):
                     stream = await self._open_stream_with_redial(
                         peer_id, name, None if call_span is None else call_span.context_bytes()
                     )
                     try:
                         try:
-                            await stream.send(payload)
+                            payload_len = await _send_payload(stream, payload)
                             await stream.close_send()
                         except StreamClosedError:
                             # the request never left: safe to retry for any RPC
@@ -889,7 +906,7 @@ class P2P:
                             response = await _CHAOS.inject(
                                 "p2p.unary.recv", payload=response, scope=str(self.peer_id)
                             )
-                        _RPC_BYTES.inc(len(payload), handler=name, direction="out")
+                        _RPC_BYTES.inc(payload_len, handler=name, direction="out")
                         _RPC_BYTES.inc(len(response), handler=name, direction="in")
                         return _parse(response, response_type)
                     finally:
@@ -930,18 +947,16 @@ class P2P:
                         payload = _serialize(request)
                         if _CHAOS.enabled:  # injection point: per streamed request message
                             payload = await _CHAOS.inject(
-                                "p2p.stream.send", payload=payload, scope=str(self.peer_id)
+                                "p2p.stream.send", payload=_chaos_payload(payload), scope=str(self.peer_id)
                             )
-                        bytes_out += len(payload)
-                        await stream.send(payload)
+                        bytes_out += await _send_payload(stream, payload)
                 else:
                     payload = _serialize(requests)
                     if _CHAOS.enabled:
                         payload = await _CHAOS.inject(
-                            "p2p.stream.send", payload=payload, scope=str(self.peer_id)
+                            "p2p.stream.send", payload=_chaos_payload(payload), scope=str(self.peer_id)
                         )
-                    bytes_out += len(payload)
-                    await stream.send(payload)
+                    bytes_out += await _send_payload(stream, payload)
                 await stream.close_send()
             except (StreamClosedError, asyncio.CancelledError):
                 pass

@@ -74,7 +74,25 @@ _SATURATION_COUNTERS = {
     "sheds": "hivemind_moe_shed_total",
     "decode_evictions": "hivemind_moe_decode_session_evictions_total",
     "decode_resets": "hivemind_moe_decode_session_resets_total",
+    "wire_bytes_sent": "hivemind_moe_bytes_sent_total",
+    "wire_bytes_received": "hivemind_moe_bytes_received_total",
 }
+
+# serving-path wire accounting (ISSUE 10): serialized expert RPC payload bytes
+# by the role this process played — "client" = RemoteExpert callers here,
+# "server" = the ConnectionHandler. The compressed-RPC win (fp16 activations ≈
+# half the fp32 wire bytes) is read directly off these, and the llama serving
+# benchmark asserts they move in --smoke mode.
+WIRE_BYTES_SENT = REGISTRY.counter(
+    "hivemind_moe_bytes_sent_total",
+    "expert RPC payload bytes sent on the serving path",
+    ("direction",),
+)
+WIRE_BYTES_RECEIVED = REGISTRY.counter(
+    "hivemind_moe_bytes_received_total",
+    "expert RPC payload bytes received on the serving path",
+    ("direction",),
+)
 
 
 def is_overload_error(error: BaseException) -> bool:
@@ -531,6 +549,9 @@ def collect_swarm_serving(records: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
                 values = list((sat.get(source) or {}).values())
                 if values:
                     entry[field] = float(values[0])
+            for field in ("wire_bytes_sent", "wire_bytes_received"):
+                if sat.get(field):
+                    entry[field] = float(sat[field])
             if sat.get("sheds"):
                 entry["sheds"] = float(sat["sheds"])
             if entry:
@@ -579,6 +600,11 @@ def format_saturation_parts(entry: Dict[str, float], red: str = "", reset: str =
         parts.append(f"runtime util {entry['runtime_utilization']:.0%}")
     if "decode_session_occupancy" in entry:
         parts.append(f"decode sessions {entry['decode_session_occupancy']:.0%} full")
+    if "wire_bytes_sent" in entry or "wire_bytes_received" in entry:
+        parts.append(
+            f"wire {entry.get('wire_bytes_sent', 0.0) / 1e6:.1f}MB out"
+            f" / {entry.get('wire_bytes_received', 0.0) / 1e6:.1f}MB in"
+        )
     if "sheds" in entry:
         parts.append(f"{red}SHEDS {entry['sheds']:g}{reset}")
     return parts

@@ -1,11 +1,43 @@
 """Split serialized tensors into stream-sized chunks and combine them back
-(capability parity: reference hivemind/utils/streaming.py:14-46)."""
+(capability parity: reference hivemind/utils/streaming.py:14-46), plus the
+scatter-gather wire-message container shared by the p2p layer and the
+serving-path protobuf splicers (compression/serialization.py)."""
 
 from __future__ import annotations
 
-from typing import Iterable, Iterator, List, TypeVar
+from typing import Iterable, Iterator, List, Tuple, TypeVar, Union
 
 STREAMING_CHUNK_SIZE_BYTES = 2**16
+
+Buffer = Union[bytes, bytearray, memoryview]
+
+
+class WireParts:
+    """One wire message as a list of buffers whose concatenation IS the
+    serialized protobuf — the serving-path analog of the averaging path's
+    scatter-gather framing (ISSUE 6): a multi-MB tensor buffer rides to the
+    AEAD as its own buffer instead of being copied into one materialized
+    ``SerializeToString`` blob. The p2p send paths (``MuxStream.send``,
+    ``call_protobuf_handler``, the stream feeders) accept this wherever they
+    accept a protobuf message; the receive side is unchanged (one decrypted
+    frame, parsed as usual)."""
+
+    __slots__ = ("parts",)
+
+    def __init__(self, *parts: Buffer):
+        self.parts: Tuple[Buffer, ...] = tuple(p for p in parts if len(p))
+
+    @property
+    def nbytes(self) -> int:
+        return sum(len(part) for part in self.parts)
+
+    def join(self) -> bytes:
+        """Materialize (chaos injection / non-scatter-gather fallbacks only —
+        the hot path must pass ``parts`` through unjoined)."""
+        return b"".join(bytes(part) if not isinstance(part, bytes) else part for part in self.parts)
+
+    def __len__(self) -> int:
+        return self.nbytes
 
 
 def split_for_streaming(data: bytes, chunk_size_bytes: int = STREAMING_CHUNK_SIZE_BYTES) -> Iterator[bytes]:

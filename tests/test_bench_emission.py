@@ -203,6 +203,37 @@ def test_bench_artifact_embeds_ledger_and_watchdog_attribution():
     assert "attribution" not in full["extra"]["averaging_extra"]
 
 
+def test_benchmark_llama_serving_smoke():
+    """ISSUE 10: the serving data path end-to-end (checkpoint load + Server +
+    RemoteSequential KV-cache decode over real RPC) — --smoke exits nonzero on
+    any failed request or if the serving wire-bytes counters did not move, so a
+    compressed-RPC/batching regression fails tier-1 loudly (mirrors the
+    benchmark_averaging smoke pattern)."""
+    import subprocess
+    import sys
+
+    script = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks", "benchmark_llama_serving.py",
+    )
+    run = subprocess.run(
+        [sys.executable, script, "--smoke", "--platform", "cpu"],
+        timeout=240,
+        capture_output=True,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert run.returncode == 0, f"smoke benchmark failed:\n{run.stdout[-2000:]}\n{run.stderr[-2000:]}"
+    payload = next(line for line in run.stdout.splitlines() if line.startswith("{"))
+    result = json.loads(payload)
+    assert result["metric"] == "llama_checkpoint_decode"
+    # any failed request exits nonzero before the JSON prints (asserted above)
+    wire = result["extra"]["wire_bytes_per_token"]
+    assert wire["sent"] > 0 and wire["received"] > 0
+    # the default A/B config rides fp16 activations on the wire
+    assert result["extra"]["activation_compression"] == "float16"
+
+
 def test_bench_artifact_embeds_serving_attribution():
     """ISSUE 9: the llama-serving swarm's per-request attribution summary rides
     the BENCH artifact under telemetry.serving — per-expert p50/p95, phase

@@ -72,7 +72,10 @@ def test_register_custom_expert_end_to_end():
         out = expert(x)
         backend = server.backends["gated_test_grid.0"]
         expected = backend.module.apply({"params": backend.params}, x)
-        assert np.allclose(np.asarray(out), np.asarray(expected), atol=1e-4)
+        # fp16 wire tolerance: the server's default activation compression is
+        # negotiated via the DHT record this test resolved (exact-wire behavior
+        # is covered by test_serving_compression.py)
+        assert np.allclose(np.asarray(out), np.asarray(expected), atol=2e-2)
         client_dht.shutdown()
     finally:
         server.shutdown()

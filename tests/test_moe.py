@@ -215,7 +215,9 @@ def test_multi_tensor_expert_backend_and_remote():
     # over RPC: schemas travel through rpc_info, both passes work, grads flow to
     # EVERY input
     dht = DHT(start=True)
-    server = Server(dht, {"multi.0": backend})
+    # exact-numerics fixture: wire precision is covered by the compressed-RPC
+    # equivalence suite (test_serving_compression.py)
+    server = Server(dht, {"multi.0": backend}, activation_compression="none")
     try:
         server.run_in_background(await_ready=True)
         client_dht = DHT(initial_peers=[str(m) for m in dht.get_visible_maddrs()], start=True)
@@ -584,6 +586,9 @@ def test_decode_sessions_over_rpc():
     server = Server.create(
         expert_uids=["dblk.0", "dblk.1"], expert_cls="llama_block", hidden_dim=16,
         start=True, optim_factory=lambda: optax.sgd(1e-4),
+        # exact decode-vs-recompute math is the subject: bit-exact wire (fp16
+        # wire tolerance is covered by test_serving_compression.py)
+        activation_compression="none",
     )
     client_dht = None
     try:
@@ -638,11 +643,13 @@ def test_decode_span_execution_across_two_servers():
     server_a = Server.create(
         expert_uids=["span.0", "span.1"], expert_cls="causal_transformer", hidden_dim=16,
         start=True, optim_factory=lambda: optax.sgd(1e-4),
+        activation_compression="none",  # exact span-vs-recompute math is the subject
     )
     server_b = Server.create(
         expert_uids=["span.2", "span.3"], expert_cls="causal_transformer", hidden_dim=16,
         dht=None, start=True, optim_factory=lambda: optax.sgd(1e-4),
         initial_peers=[str(m) for m in server_a.dht.get_visible_maddrs()],
+        activation_compression="none",
     )
     client_dht = None
     try:
@@ -908,6 +915,9 @@ def test_drain_cancellation_releases_pins_and_unblocks_callers():
     rng = np.random.RandomState(0)
     sid = uuid.uuid4().hex
     manager.decode("pin.0", sid, rng.randn(1, 4, 16).astype(np.float32), reset=True)
+    # a second recently-active session keeps the continuous-batching (drainer)
+    # path engaged — a lone stream routes onto the direct path since ISSUE 10
+    manager.decode("pin.0", uuid.uuid4().hex, rng.randn(1, 4, 16).astype(np.float32), reset=True)
 
     release, entered = threading.Event(), threading.Event()
 
@@ -947,6 +957,9 @@ def test_decode_continuous_batching_many_clients():
     server = Server.create(
         expert_uids=["cbat.0"], expert_cls="causal_transformer", hidden_dim=16,
         start=True, optim_factory=lambda: optax.sgd(1e-4),
+        # batched-vs-direct device math is the subject: bit-exact wire (fp16
+        # wire tolerance is covered by test_serving_compression.py)
+        activation_compression="none",
     )
     client_dht = None
     try:

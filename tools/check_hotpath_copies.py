@@ -1,11 +1,13 @@
 #!/usr/bin/env python3
-"""Lint: keep the averaging hot path copy-free (ISSUE 6 satellite).
+"""Lint: keep the averaging AND serving hot paths copy-free (ISSUE 6 satellite;
+serving coverage added by ISSUE 10).
 
 The throughput work in ISSUE 6 removed per-part byte concats and always-copy
-``astype`` calls from the tensor→wire pipeline. This lint keeps them out of the
-four hot-path files:
+``astype`` calls from the averaging tensor→wire pipeline; ISSUE 10 did the same
+for the serving data path. This lint keeps them out of the hot-path files:
 
-    p2p/mux.py, p2p/crypto_channel.py, averaging/partition.py, averaging/allreduce.py
+    p2p/mux.py, p2p/crypto_channel.py, averaging/partition.py, averaging/allreduce.py,
+    moe/client/expert.py, moe/server/connection_handler.py, moe/server/task_pool.py
 
 Rules:
 
@@ -42,6 +44,9 @@ HOT_FILES = (
     "p2p/crypto_channel.py",
     "averaging/partition.py",
     "averaging/allreduce.py",
+    "moe/client/expert.py",
+    "moe/server/connection_handler.py",
+    "moe/server/task_pool.py",
 )
 
 Finding = Tuple[str, str, str]  # (relpath, enclosing function, kind)
