@@ -84,7 +84,7 @@ def _tpu_probe(attempts: int = 3, timeout: float = 120.0):
     return False, errors
 
 
-def _averaging_gbps(timeout: float = 420.0):
+def _averaging_gbps(timeout: float = 420.0, compression: str = "FLOAT16"):
     """Second driver metric: butterfly all-reduce GB/s/peer (CPU/network-bound, does
     not need the TPU). Run in a subprocess so a swarm hang can't take down the bench."""
     import os
@@ -97,7 +97,7 @@ def _averaging_gbps(timeout: float = 420.0):
         run = subprocess.run(
             [sys.executable, script, "--num_peers", "4", "--target_group_size", "4",
              "--num_rounds", "3", "--num_params", "4000000",
-             "--min_matchmaking_time", "1.0"],
+             "--min_matchmaking_time", "1.0", "--compression", compression],
             timeout=timeout, capture_output=True, text=True,
         )
         for line in run.stdout.splitlines():
@@ -107,6 +107,13 @@ def _averaging_gbps(timeout: float = 420.0):
     except (subprocess.TimeoutExpired, json.JSONDecodeError, OSError):
         pass
     return None
+
+
+def _averaging_gbps_q8(timeout: float = 420.0):
+    """The quantized tier of the same A/B (ISSUE 11): identical swarm/payload
+    with the uniform8 wire codec (per-link error feedback on), so BENCH
+    artifacts track the 8-bit GB/s/peer (fp32-equivalent) next to fp16."""
+    return _averaging_gbps(timeout=timeout, compression="uniform8")
 
 
 def _llama_serving(timeout: float = 420.0):
@@ -398,11 +405,13 @@ def _probe_point(label: str, probe_log: list, attempts: int) -> bool:
 _COMPACT_EXTRA_KEYS = (
     "device", "mfu", "batch_size", "remat", "seq_len", "final_loss",
     "attention", "masked_loss_fraction", "averaging_gbps_per_peer",
+    "averaging_gbps_q8_per_peer",
 )
 # least-important-first drop order when the compact line must shrink to fit
 _COMPACT_DROP_ORDER = (
     "tpu_probes", "masked_loss_fraction", "attention", "final_loss", "remat",
-    "batch_size", "seq_len", "device", "averaging_gbps_per_peer", "mfu",
+    "batch_size", "seq_len", "device", "averaging_gbps_q8_per_peer",
+    "averaging_gbps_per_peer", "mfu",
 )
 
 
@@ -496,6 +505,7 @@ def main() -> None:
     if _probe_point("round_start", probe_log, attempts=3):
         result = _try_measure(diagnostics)
     averaging = _averaging_gbps()
+    averaging_q8 = _averaging_gbps_q8()
     serving = _llama_serving()
     if result is None or result.get("tpu_unavailable"):
         # a tunnel wedged at round start may be free now (the averaging swarm just
@@ -514,6 +524,11 @@ def main() -> None:
 
     result.setdefault("extra", {})
     result["extra"]["averaging_gbps_per_peer"] = (averaging or {}).get("value")
+    # the quantized tier's fp32-equivalent rate + its success rate (the lossy
+    # tier must not buy throughput with failed rounds)
+    result["extra"]["averaging_gbps_q8_per_peer"] = (averaging_q8 or {}).get("value")
+    q8_extra = (averaging_q8 or {}).get("extra") or {}
+    result["extra"]["averaging_q8_success_rate"] = q8_extra.get("success_rate")
     result["extra"]["llama_serving_tok_s"] = (serving or {}).get("value")
     # the swarm telemetry + attribution snapshots land ONCE, in
     # result["telemetry"] below — strip them from the copied extra so the

@@ -20,7 +20,11 @@ def main():
     parser.add_argument("--target_group_size", type=int, default=4)
     parser.add_argument("--num_rounds", type=int, default=3)
     parser.add_argument("--num_params", type=int, default=1_000_000)
-    parser.add_argument("--compression", default="FLOAT16")
+    parser.add_argument("--compression", default="FLOAT16",
+                        help="wire codec: a CompressionType name (FLOAT16, NONE, ...) or a "
+                             "wire-tier alias (none/float16/uniform8/blockwise8, case-"
+                             "insensitive). The 8-bit tiers negotiate per-link error "
+                             "feedback automatically (ISSUE 11)")
     parser.add_argument("--part_size_bytes", type=int, default=None,
                         help="pre-compression part size (default: the library default, "
                              "2 MiB — measured fastest on loopback; clamped to the mux cap)")
@@ -28,6 +32,12 @@ def main():
                         help="leader's group-collection window; on loopback the group "
                              "fills (and begins early) well before 1s, so the floor is "
                              "pure overhead — lower it when benchmarking bandwidth")
+    parser.add_argument("--simulated_link_mbps", type=float, default=None,
+                        help="throttle every tensor-part/delta payload to this per-link "
+                             "bandwidth via the chaos engine's byte-proportional `throttle` "
+                             "action — the WAN regime the quantized tiers exist for. "
+                             "Unthrottled loopback is latency-bound, so wire-codec wins "
+                             "are only representative under a link budget")
     parser.add_argument("--smoke", action="store_true",
                         help="tier-1-safe regression mode: tiny swarm + payload, exits "
                              "nonzero unless every round succeeds (wired into tests so "
@@ -51,7 +61,18 @@ def main():
     first = DHT(start=True)
     maddrs = [str(m) for m in first.get_visible_maddrs()]
     dhts = [first] + [DHT(initial_peers=maddrs, start=True) for _ in range(args.num_peers - 1)]
-    codec = get_codec(getattr(CompressionType, args.compression))
+    # wire-tier aliases (uniform8 etc.) map onto the enum; enum names pass through
+    tier_aliases = {"none": "NONE", "float16": "FLOAT16", "uniform8": "UNIFORM_8BIT",
+                    "blockwise8": "BLOCKWISE_8BIT", "meanstd16": "MEANSTD_16BIT",
+                    "quantile8": "QUANTILE_8BIT"}
+    compression_name = tier_aliases.get(args.compression.lower(), args.compression.upper())
+    codec = get_codec(getattr(CompressionType, compression_name))
+    if args.simulated_link_mbps:
+        from hivemind_tpu.resilience import CHAOS
+
+        rate_bytes_s = args.simulated_link_mbps * 125_000.0
+        CHAOS.add_rule("allreduce.load", "throttle", rate=rate_bytes_s)
+        CHAOS.add_rule("allreduce.reduce", "throttle", rate=rate_bytes_s)
     averager_kwargs = {}
     if args.part_size_bytes is not None:
         averager_kwargs["part_size_bytes"] = args.part_size_bytes
@@ -91,6 +112,8 @@ def main():
         "extra": {
             "peers": args.num_peers, "rounds": args.num_rounds,
             "params": args.num_params, "success_rate": successes / max(attempts, 1),
+            "compression": compression_name.lower(),
+            "simulated_link_mbps": args.simulated_link_mbps,
             "seconds_per_round": round(elapsed / args.num_rounds, 3),
             # the registry saw every matchmaking/all-reduce/DHT event of this
             # swarm: embed it so BENCH artifacts carry the per-phase breakdown

@@ -25,7 +25,7 @@ from hivemind_tpu.averaging.partition import (
 )
 from hivemind_tpu.compression import CompressionBase, NoCompression, deserialize_tensor, serialize_tensor
 from hivemind_tpu.p2p import P2P, P2PContext, PeerID
-from hivemind_tpu.proto import averaging_pb2
+from hivemind_tpu.proto import averaging_pb2, runtime_pb2
 from hivemind_tpu.resilience import CHAOS as _CHAOS
 from hivemind_tpu.resilience import BreakerBoard
 from hivemind_tpu.utils.asyncio_utils import aiter_with_timeout, run_in_executor
@@ -89,6 +89,11 @@ class AllReduceRunner:
 
     :param peer_element_counts: reduction span sizes per peer (load balancer output)
     :param get_stub: callable (peer_id) -> stub with .rpc_aggregate_part(stream)
+    :param links: negotiated per-link wire codecs (peer_index ->
+        :class:`~hivemind_tpu.averaging.wire_codec.WireLink`); absent entries
+        fall back to ``compression`` (exact pre-negotiation behavior)
+    :param residuals: the averager's error-feedback store (required for links
+        with ``error_feedback``; survives the runner — one round borrows it)
     """
 
     def __init__(
@@ -107,6 +112,8 @@ class AllReduceRunner:
         sender_timeout: float = 30.0,
         reducer_timeout: float = 60.0,
         prefetch: int = 8,
+        links: Optional[Dict[int, "WireLink"]] = None,
+        residuals=None,
     ):
         self.p2p, self.group_id = p2p, group_id
         # one part travels as ONE mux message: a part whose wire size exceeded
@@ -138,16 +145,43 @@ class AllReduceRunner:
                 self.sender_ranks[peer_index] = len(self.sender_ranks)
         self.num_senders = len(self.sender_ranks)
 
+        self.links = dict(links) if links else {}
+        self.residuals = residuals
+        if self.residuals is not None and any(link.error_feedback for link in self.links.values()):
+            self.residuals.ensure(sum(self.peer_element_counts))
+        peer_links = (
+            [self.links.get(index) for index in range(len(self.ordered_peer_ids))] if self.links else None
+        )
         # prefetch widens the in-flight part window per peer exchange: up to this
         # many parts may sit serialized ahead of the stream writer, keeping the
         # compress → encrypt → send stages concurrently busy
         self.container = TensorPartContainer(
-            tensors, peer_element_counts, compression, part_size_bytes, prefetch=prefetch
+            tensors, peer_element_counts, compression, part_size_bytes, prefetch=prefetch,
+            peer_links=peer_links, residuals=residuals,
         ) if self.my_mode != AveragingMode.AUX else None
         my_part_shapes = self._span_part_shapes(self.my_index, part_size_bytes)
         self.reducer = TensorPartReducer(my_part_shapes, self.num_senders)
         self.compression = compression
         self.part_size_bytes = part_size_bytes
+        # quantized delta leg (ISSUE 11): the averaged value of each part is
+        # quantized ONCE per lossy tier and the same payload goes to every
+        # lossy-link sender; EF touches the "reduce" residual exactly once per
+        # part per round. Offsets map part_index -> global stream position.
+        self._my_span_start = sum(self.peer_element_counts[: self.my_index])
+        self._part_offsets = [0]
+        for shape in my_part_shapes:
+            self._part_offsets.append(self._part_offsets[-1] + int(np.prod(shape)))
+        self._absolute_payloads: Dict[Tuple[int, str], "asyncio.Future"] = {}
+        self._absolute_consumed: Dict[Tuple[int, str], int] = {}
+        self._reduce_ef_parts: set = set()
+        # how many sender streams will consume each cached absolute payload:
+        # once all of them have taken a part, its payload is dropped (the cache
+        # stays bounded by the in-flight window, not the whole reduced span)
+        self._lossy_sender_count = sum(
+            1
+            for peer_index in self.sender_ranks
+            if (lossy_link := self.links.get(peer_index)) is not None and lossy_link.error_feedback
+        )
         # sender bans are the degenerate case of the shared cross-layer breaker
         # (resilience/breaker.py): threshold 1, infinite recovery — tripped once,
         # banned for the round's lifetime. `rank in banned_senders` still works.
@@ -258,6 +292,7 @@ class AllReduceRunner:
             parent=self._round_span,
             peer=str(self.p2p.peer_id),
             remote=str(peer_id),
+            codec=self._link_tier(peer_index),
         ) as exchange_span:
             await self._communicate_with_peer_traced(peer_index, peer_id, phase_started, exchange_span)
 
@@ -269,7 +304,12 @@ class AllReduceRunner:
                 first = True
                 async for serialized in self.container.iterate_input_parts_for(peer_index):
                     if _CHAOS.enabled:  # injection point: per part shipped to a reducer
-                        await _CHAOS.inject("allreduce.load", scope=str(self.p2p.peer_id))
+                        payload = serialized.buffer
+                        injected = await _CHAOS.inject(
+                            "allreduce.load", payload=payload, scope=str(self.p2p.peer_id)
+                        )
+                        if injected is not payload:
+                            serialized.buffer = injected
                     _AVG_BYTES_SENT.inc(serialized.ByteSize())
                     yield averaging_pb2.AveragingData(
                         code=averaging_pb2.PART_DATA,
@@ -292,8 +332,14 @@ class AllReduceRunner:
                 _AVG_BYTES_RECEIVED.inc(response.tensor_part.ByteSize())
                 # decode off the event loop (symmetric to the serialize side) so the
                 # loop keeps shoveling frames while numpy unpacks the previous delta
-                delta = await run_in_executor(deserialize_tensor, response.tensor_part)
-                self.container.register_processed_part(peer_index, part_index, delta)
+                processed = await run_in_executor(deserialize_tensor, response.tensor_part)
+                if response.absolute_part:
+                    # quantized leg: the payload is the reduced average itself
+                    # (quantized once, with the reducer's error feedback); the
+                    # delta is recovered against our own input locally
+                    self.container.register_processed_absolute(peer_index, part_index, processed)
+                else:
+                    self.container.register_processed_part(peer_index, part_index, processed)
                 part_index += 1
             if part_index < self.container.num_parts_by_peer[peer_index]:
                 raise AllreduceException(
@@ -389,19 +435,51 @@ class AllReduceRunner:
                     if averaged is None:
                         yield averaging_pb2.AveragingData(code=averaging_pb2.CANCELLED)
                         return
-                if _CHAOS.enabled:  # injection point: per delta returned to a sender
-                    await _CHAOS.inject("allreduce.reduce", scope=str(self.p2p.peer_id))
-                delta = averaged - part.astype(np.float32, copy=False)
-                # the delta is a fresh private array: the codec may clip/normalize it
-                # in place instead of allocating another copy
-                serialized_delta = await run_in_executor(
-                    serialize_tensor, delta, self.compression, None, True
-                )
-                _AVG_BYTES_SENT.inc(serialized_delta.ByteSize())
-                yield averaging_pb2.AveragingData(
-                    code=averaging_pb2.PART_DATA,
-                    tensor_part=serialized_delta,
-                )
+                link = self.links.get(sender_peer_index)
+                if link is not None and link.error_feedback and self.residuals is not None:
+                    # quantized leg: ship the averaged part itself, quantized
+                    # ONCE per tier with reducer-side error feedback — every
+                    # lossy sender gets the same bytes, and senders recover
+                    # their delta locally (absolute_part)
+                    serialized_part = await self._absolute_average(part_index, averaged, link)
+                    if _CHAOS.enabled:  # injection point: per delta returned to a sender
+                        payload = serialized_part.buffer
+                        injected = await _CHAOS.inject(
+                            "allreduce.reduce", payload=payload, scope=str(self.p2p.peer_id)
+                        )
+                        if injected is not payload:
+                            # the cached message is shared across senders: only
+                            # THIS sender's copy gets the corruption
+                            corrupted_part = runtime_pb2.Tensor()
+                            corrupted_part.CopyFrom(serialized_part)
+                            corrupted_part.buffer = injected
+                            serialized_part = corrupted_part
+                    _AVG_BYTES_SENT.inc(serialized_part.ByteSize())
+                    yield averaging_pb2.AveragingData(
+                        code=averaging_pb2.PART_DATA,
+                        tensor_part=serialized_part,
+                        absolute_part=True,
+                    )
+                else:
+                    delta = averaged - part.astype(np.float32, copy=False)
+                    # the delta is a fresh private array: the codec may clip/normalize
+                    # it in place instead of allocating another copy
+                    serialized_delta = await run_in_executor(
+                        serialize_tensor, delta,
+                        link.codec if link is not None else self.compression, None, True,
+                    )
+                    if _CHAOS.enabled:  # injection point: per delta returned to a sender
+                        payload = serialized_delta.buffer
+                        injected = await _CHAOS.inject(
+                            "allreduce.reduce", payload=payload, scope=str(self.p2p.peer_id)
+                        )
+                        if injected is not payload:
+                            serialized_delta.buffer = injected
+                    _AVG_BYTES_SENT.inc(serialized_delta.ByteSize())
+                    yield averaging_pb2.AveragingData(
+                        code=averaging_pb2.PART_DATA,
+                        tensor_part=serialized_delta,
+                    )
                 part_index += 1
         except (ConnectionError, asyncio.CancelledError, GeneratorExit):
             self._ban_sender(sender_rank, "stream interrupted", cause="interrupted")
@@ -425,6 +503,65 @@ class AllReduceRunner:
             self._ban_sender(
                 sender_rank, f"sent only {part_index}/{len(self.reducer.part_shapes)} parts", cause="incomplete"
             )
+
+    def _link_tier(self, peer_index: int) -> str:
+        """The wire tier name of one link, for span/ledger attribution."""
+        link = self.links.get(peer_index)
+        if link is not None:
+            return link.tier
+        from hivemind_tpu.compression.serialization import codec_name
+
+        return codec_name(self.compression)
+
+    async def _absolute_average(self, part_index: int, averaged: np.ndarray, link) -> runtime_pb2.Tensor:
+        """Quantize one averaged part for the lossy delta leg, single-flight per
+        (part, tier): concurrent sender streams share the payload, and the EF
+        residual update runs exactly once per part per round (a second lossy
+        tier in the same group — rare — quantizes the raw average)."""
+        key = (part_index, link.tier)
+        future = self._absolute_payloads.get(key)
+        if future is not None:
+            serialized = await asyncio.shield(future)
+            self._consume_absolute(key)
+            return serialized
+        future = asyncio.get_event_loop().create_future()
+        self._absolute_payloads[key] = future
+        self._absolute_consumed[key] = 0
+        apply_feedback = part_index not in self._reduce_ef_parts
+        if apply_feedback:
+            self._reduce_ef_parts.add(part_index)
+
+        def _quantize() -> runtime_pb2.Tensor:
+            if apply_feedback:
+                from hivemind_tpu.averaging.residual import compress_with_feedback
+
+                start = self._my_span_start + self._part_offsets[part_index]
+                residual = self.residuals.view("reduce", start, start + averaged.size)
+                return compress_with_feedback(averaged, link.codec, residual)
+            return serialize_tensor(averaged, link.codec)
+
+        try:
+            serialized = await run_in_executor(_quantize)
+        except BaseException as e:
+            future.set_exception(e)
+            # a co-waiting stream will consume it; if none does, don't warn
+            future.exception()
+            raise
+        future.set_result(serialized)
+        self._consume_absolute(key)
+        return serialized
+
+    def _consume_absolute(self, key: Tuple[int, str]) -> None:
+        """One lossy sender took this cached payload; drop it once every lossy
+        sender has (a banned sender simply leaves its parts cached until the
+        round ends — bounded by the original lifetime, not worse)."""
+        count = self._absolute_consumed.get(key)
+        if count is None:
+            return
+        self._absolute_consumed[key] = count + 1
+        if self._absolute_consumed[key] >= self._lossy_sender_count:
+            self._absolute_payloads.pop(key, None)
+            self._absolute_consumed.pop(key, None)
 
     def _ban_sender(self, sender_rank: int, reason: str, cause: str = "error") -> None:
         if sender_rank not in self.banned_senders:

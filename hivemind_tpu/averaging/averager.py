@@ -26,6 +26,17 @@ from hivemind_tpu.averaging.key_manager import GroupKeyManager
 from hivemind_tpu.averaging.load_balancing import load_balance_peers
 from hivemind_tpu.averaging.matchmaking import Matchmaking, MatchmakingException
 from hivemind_tpu.averaging.partition import AllreduceException, DEFAULT_PART_SIZE_BYTES
+from hivemind_tpu.averaging.residual import ResidualStore
+from hivemind_tpu.averaging.wire_codec import (
+    WIRE_TIERS,
+    LinkCodecPolicy,
+    WireLink,
+    make_advert,
+    negotiate_link,
+    parse_advert,
+    publish_link_gauges,
+    tier_of_codec,
+)
 from hivemind_tpu.averaging.state_sync import (
     STATE_CHUNK_BYTES,
     STATE_SYNC_BYTES_SENT as _STATE_SYNC_BYTES_SENT,
@@ -103,6 +114,9 @@ class DecentralizedAverager(ServicerBase):
         reducer_timeout: float = 60.0,
         compression: CompressionBase = NoCompression(),
         part_size_bytes: int = DEFAULT_PART_SIZE_BYTES,
+        wire_tiers: Optional[Sequence[str]] = None,
+        adaptive_link_codec: bool = False,
+        link_policy: Optional[LinkCodecPolicy] = None,
         bandwidth: Optional[float] = None,
         client_mode: bool = False,
         auxiliary: bool = False,
@@ -125,6 +139,26 @@ class DecentralizedAverager(ServicerBase):
         self.sender_timeout, self.reducer_timeout = sender_timeout, reducer_timeout
         self.compression, self.part_size_bytes = compression, part_size_bytes
         self.state_compression = state_compression if state_compression is not None else compression
+        # per-link wire-codec negotiation (ISSUE 11): advertise the tiers we
+        # support + our default (= the configured codec's tier) in every
+        # matchmaking gather blob. A configured codec outside the tier ladder
+        # (meanstd/quantile) disables negotiation — links use it as-is.
+        self._wire_tier = tier_of_codec(self.compression)
+        tiers = tuple(wire_tiers) if wire_tiers is not None else WIRE_TIERS
+        if self._wire_tier is not None and self._wire_tier not in tiers:
+            tiers = (*tiers, self._wire_tier)
+        self._wire_tiers = tuple(t for t in tiers if t in WIRE_TIERS)
+        self._wire_residuals = ResidualStore()
+        if link_policy is not None:
+            self._link_policy: Optional[LinkCodecPolicy] = link_policy
+            if self._link_policy.default_tier is None:
+                self._link_policy.default_tier = self._wire_tier
+        else:
+            self._link_policy = (
+                LinkCodecPolicy(default_tier=self._wire_tier)
+                if adaptive_link_codec and self._wire_tier is not None
+                else None
+            )
         self.bandwidth = bandwidth if bandwidth is not None else (0.0 if client_mode else 1.0e8)
         self.declare_state_period = declare_state_period
         self.shutdown_timeout = shutdown_timeout
@@ -352,7 +386,9 @@ class DecentralizedAverager(ServicerBase):
             deadline=now + timeout if timeout is not None else None,
             allow_retries=allow_retries,
             weight=weight,
-            data_for_gather=MSGPackSerializer.dumps([self.bandwidth, self.mode.value, gather]),
+            data_for_gather=MSGPackSerializer.dumps(
+                [self.bandwidth, self.mode.value, gather, self._wire_advert()]
+            ),
         )
         if not require_trigger:
             control.allow_allreduce()
@@ -408,14 +444,74 @@ class DecentralizedAverager(ServicerBase):
         except Exception as e:
             control.set_exception(e)
 
+    def _wire_advert(self) -> Optional[Dict[str, Any]]:
+        """The codec advert riding this peer's matchmaking gather blob — the
+        zero-extra-round-trip negotiation channel (every groupmate sees every
+        advert at BEGIN_ALLREDUCE, mirroring the serving path's ``peer|codec``
+        DHT records). Carries the straggler policy's current demotions."""
+        if self._wire_tier is None:
+            return None
+        demotions: Dict[str, str] = {}
+        if self._link_policy is not None:
+            try:
+                local = str(self.peer_id) if hasattr(self, "peer_id") else None
+                demotions = self._link_policy.refresh(exclude=(local,) if local else ())
+            except Exception as e:
+                logger.warning(f"link-codec policy refresh failed: {e!r}")
+                _AVERAGER_INTERNAL_ERRORS.inc(site="link_policy")
+        return make_advert(self._wire_tiers, self._wire_tier, demotions)
+
     def _decode_gathered(self, group_info: GroupInfo):
+        """(bandwidths, modes, user_gathered, adverts) from the gather blobs.
+        Slot 3 — the wire-codec advert (ISSUE 11) — is optional and tolerant
+        (``parse_advert`` maps anything malformed to None: that peer's links
+        just fall back to the configured codec); slots 0-2 are load-bearing
+        and a blob without them fails the round, exactly as before."""
         bandwidths, modes, user_gathered = [], [], {}
+        adverts: Dict[PeerID, Optional[Dict[str, Any]]] = {}
         for peer_id, blob in zip(group_info.peer_ids, group_info.gathered):
-            peer_bandwidth, peer_mode, user_data = MSGPackSerializer.loads(blob)
+            decoded = MSGPackSerializer.loads(blob)
+            peer_bandwidth, peer_mode, user_data = decoded[0], decoded[1], decoded[2]
             bandwidths.append(float(peer_bandwidth))
             modes.append(AveragingMode(peer_mode))
             user_gathered[peer_id] = user_data
-        return bandwidths, modes, user_gathered
+            adverts[peer_id] = parse_advert(decoded[3]) if len(decoded) > 3 else None
+        return bandwidths, modes, user_gathered, adverts
+
+    def _negotiate_links(
+        self, group_info: GroupInfo, adverts: Dict[PeerID, Optional[Dict[str, Any]]]
+    ) -> Optional[Dict[int, WireLink]]:
+        """Resolve the wire link for every groupmate from the gathered adverts.
+        Symmetric by construction: both endpoints evaluate the same pure
+        function over the same two adverts (ours is read back from the gather,
+        i.e. exactly what the remote saw). Returns None when negotiation is
+        disabled or nobody advertised — the byte-identical legacy path."""
+        if self._wire_tier is None:
+            return None
+        local_advert = adverts.get(self.peer_id)
+        if local_advert is None:
+            return None
+        links: Dict[int, WireLink] = {}
+        tiers_by_remote: Dict[str, str] = {}
+        for index, peer_id in enumerate(group_info.peer_ids):
+            if peer_id == self.peer_id:
+                continue
+            tier = negotiate_link(local_advert, adverts.get(peer_id), str(self.peer_id), str(peer_id))
+            if tier is None:
+                continue
+            links[index] = WireLink.for_tier(tier)
+            tiers_by_remote[str(peer_id)] = tier
+        if not links:
+            return None
+        publish_link_gauges(tiers_by_remote)
+        from hivemind_tpu.telemetry.tracing import current_span
+
+        span = current_span()
+        if span is not None:
+            for remote, tier in tiers_by_remote.items():
+                if tier != self._wire_tier:  # only negotiated-away links are events
+                    span.add_event("link_codec", remote=remote, tier=tier)
+        return links
 
     async def _pre_allreduce(self) -> None:
         """Hook: refresh the host tensor mirrors just before an all-reduce round.
@@ -437,7 +533,7 @@ class DecentralizedAverager(ServicerBase):
             return await self._aggregate_with_group_traced(group_info, weight)
 
     async def _aggregate_with_group_traced(self, group_info: GroupInfo, weight: float) -> GatheredData:
-        bandwidths, modes, user_gathered = self._decode_gathered(group_info)
+        bandwidths, modes, user_gathered, adverts = self._decode_gathered(group_info)
         await self._pre_allreduce()
 
         with self.lock_averaged_tensors:
@@ -450,7 +546,8 @@ class DecentralizedAverager(ServicerBase):
 
         if _CHAOS.enabled:  # injection point: die between matchmaking and the round
             await _CHAOS.inject("allreduce.setup", scope=str(self.peer_id))
-        runner = self._make_allreduce_runner(group_info, peer_element_counts, modes, weight)
+        links = self._negotiate_links(group_info, adverts)
+        runner = self._make_allreduce_runner(group_info, peer_element_counts, modes, weight, links=links)
         async with self._allreduce_registered:
             self._running_allreduces[group_info.group_id] = runner
             self._allreduce_registered.notify_all()
@@ -528,6 +625,7 @@ class DecentralizedAverager(ServicerBase):
         peer_element_counts: Sequence[int],
         modes: Sequence[AveragingMode],
         weight: float,
+        links: Optional[Dict[int, WireLink]] = None,
     ) -> AllReduceRunner:
         """Overridable factory — the designed-in fault-injection seam (the reference's
         tests override the equivalent to inject mid-stream failures, SURVEY §4)."""
@@ -544,6 +642,8 @@ class DecentralizedAverager(ServicerBase):
             part_size_bytes=self.part_size_bytes,
             sender_timeout=self.sender_timeout,
             reducer_timeout=self.reducer_timeout,
+            links=links,
+            residuals=self._wire_residuals,
         )
 
     def _snapshot_tensors(self) -> List[np.ndarray]:

@@ -15,7 +15,18 @@ memory (dtype-conversion copies or boundary assemblies) are compressed with
 ``allow_inplace=True``. The reducer accumulates with ``np.add(..., out=...)`` into
 the accumulator, stages weighted parts in one reusable scratch buffer, and divides
 in place — no per-part temporaries. All replaced ops are bit-identical to the
-naive forms (same fp32 instructions in the same order)."""
+naive forms (same fp32 instructions in the same order).
+
+Quantized wire tiers (ISSUE 11): each peer's parts may travel under a
+**per-link wire codec** (``peer_links``) negotiated at matchmaking time instead
+of the single group-wide codec. Links on a lossy tier compress through
+:func:`~hivemind_tpu.averaging.residual.compress_with_feedback` against the
+averager-owned send-leg residual plane (error feedback, indexed by global
+stream offset), and their processed results come back as **absolute averaged
+values** (:meth:`TensorPartContainer.register_processed_absolute`) rather than
+deltas — the sender subtracts its own input locally. Lossless links are
+untouched: same codec instance, same ``allow_inplace`` policy, byte-identical
+wire parts (pinned by tests/test_partition_equivalence.py)."""
 
 from __future__ import annotations
 
@@ -65,6 +76,11 @@ class TensorPartContainer:
         when possible)
     :param peer_element_counts: elements assigned to each peer (sums to total numel)
     :param prefetch: how many parts may be serialized ahead of the network consumer
+    :param peer_links: optional per-peer negotiated wire links
+        (:class:`~hivemind_tpu.averaging.wire_codec.WireLink` or None per peer);
+        None entries fall back to ``compression``
+    :param residuals: the averager's error-feedback store; required for links
+        with ``error_feedback`` set
     """
 
     def __init__(
@@ -75,6 +91,8 @@ class TensorPartContainer:
         part_size_bytes: int = DEFAULT_PART_SIZE_BYTES,
         tensor_infos: Optional[Sequence[CompressionInfo]] = None,
         prefetch: int = 4,
+        peer_links: Optional[Sequence] = None,
+        residuals=None,
     ):
         assert prefetch > 0, "prefetch must be positive"
         self.tensors = [as_numpy(t) for t in tensors]
@@ -83,6 +101,10 @@ class TensorPartContainer:
         self.part_size_elements = max(1, part_size_bytes // 4)  # parts travel as fp32
         self.tensor_infos = tensor_infos
         self.prefetch = prefetch
+        if peer_links is not None:
+            assert len(peer_links) == len(self.peer_element_counts)
+        self.peer_links = list(peer_links) if peer_links is not None else None
+        self.residuals = residuals
         total = sum(int(np.prod(t.shape)) for t in self.tensors)
         assert sum(peer_element_counts) == total, (sum(peer_element_counts), total)
         self.total_elements = total
@@ -151,14 +173,30 @@ class TensorPartContainer:
     def get_raw_input_parts(self, peer_index: int) -> List[np.ndarray]:
         return [self._input_part(start, stop)[0] for start, stop in self.parts_by_peer[peer_index]]
 
+    def link_for(self, peer_index: int):
+        return self.peer_links[peer_index] if self.peer_links is not None else None
+
     async def iterate_input_parts_for(self, peer_index: int) -> AsyncIterator[runtime_pb2.Tensor]:
         """Serialized parts destined for one peer; compression happens in the shared
-        thread pool with bounded prefetch (reference partition.py:104-112)."""
-        parts = [self._input_part(start, stop) for start, stop in self.parts_by_peer[peer_index]]
+        thread pool with bounded prefetch (reference partition.py:104-112). A link
+        on a lossy wire tier compresses through the send-leg error-feedback
+        residual (global-offset indexed; parts are disjoint spans, so prefetched
+        parts may run concurrently in the executor without racing)."""
+        link = self.link_for(peer_index)
+        codec = link.codec if link is not None else self.compression
+        use_feedback = link is not None and link.error_feedback and self.residuals is not None
+        if use_feedback:
+            from hivemind_tpu.averaging.residual import compress_with_feedback
 
-        def _compress(item: Tuple[np.ndarray, bool]) -> runtime_pb2.Tensor:
-            part, private = item
-            return serialize_tensor(part, self.compression, allow_inplace=private)
+            self.residuals.ensure(self.total_elements)
+        spans = self.parts_by_peer[peer_index]
+        parts = [(start, stop, *self._input_part(start, stop)) for start, stop in spans]
+
+        def _compress(item) -> runtime_pb2.Tensor:
+            start, stop, part, private = item
+            if use_feedback:
+                return compress_with_feedback(part, codec, self.residuals.view("send", start, stop))
+            return serialize_tensor(part, codec, allow_inplace=private)
 
         async for serialized in amap_in_executor(_compress, as_aiter(*parts), max_prefetch=self.prefetch):
             yield serialized
@@ -178,6 +216,21 @@ class TensorPartContainer:
             self._tensor_deltas[index][local_start:local_stop] = flat_delta[consumed : consumed + length]
             consumed += length
         self._mark_ready(peer_index, part_index)
+
+    def register_processed_absolute(self, peer_index: int, part_index: int, value: np.ndarray) -> None:
+        """Store a processed part that carries the reduced AVERAGE itself
+        (quantized delta leg, ``absolute_part`` on the wire): the delta is
+        recovered locally as ``value − own input``. Only error-feedback links
+        use this path, and those never compress the container's flats in place,
+        so the input part still holds the original local values."""
+        start, stop = self.parts_by_peer[peer_index][part_index]
+        value32 = value.reshape(-1).astype(np.float32, copy=False)
+        if value32.size != stop - start:
+            raise AllreduceException(
+                f"absolute part size mismatch from peer {peer_index}: got {value32.size}, expected {stop - start}"
+            )
+        local, _private = self._input_part(start, stop)
+        self.register_processed_part(peer_index, part_index, value32 - local)
 
     def register_failed_reducer(self, peer_index: int) -> None:
         """A reducer died: its unprocessed parts keep the local value (delta = 0)

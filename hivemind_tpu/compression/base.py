@@ -58,6 +58,9 @@ def _dtype_name(array: np.ndarray) -> str:
 
 class CompressionBase(ABC):
     compression_type: int = CompressionType.NONE
+    # True when extract(compress(x)) != x in general — the averaging wire layer
+    # uses this to decide whether error-feedback residuals apply (ISSUE 11)
+    is_lossy: bool = False
 
     @abstractmethod
     def compress(self, array: Any, info: Optional[CompressionInfo] = None, allow_inplace: bool = False) -> runtime_pb2.Tensor:

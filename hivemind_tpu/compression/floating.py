@@ -21,6 +21,7 @@ class Float16Compression(CompressionBase):
     """Clamp to the fp16 range and cast (reference floating.py:10-40)."""
 
     compression_type = CompressionType.FLOAT16
+    is_lossy = True
 
     def compress(self, array: Any, info: Optional[CompressionInfo] = None, allow_inplace: bool = False) -> runtime_pb2.Tensor:
         array = as_numpy(array)

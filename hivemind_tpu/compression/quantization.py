@@ -1,12 +1,30 @@
 """8-bit quantization codecs (capability parity: reference
-hivemind/compression/quantization.py). The math lives in hivemind_tpu.ops.quantization
-as jitted jax functions — on TPU inputs it runs on device; numpy inputs go through the
-CPU jax backend (same code, no thread-pool machinery needed)."""
+hivemind/compression/quantization.py).
+
+ISSUE 11 rework: these codecs are now on the averaging WIRE hot path (the
+butterfly all-reduce's reduce-scatter and all-gather legs run them per part in
+the shared executor), so the compress/extract paths are pure numpy — no jit
+dispatch, no host↔device hop — and copy-discipline matches the Float16 path
+from ISSUE 6/10 (this file is covered by ``tools/check_hotpath_copies.py``):
+
+- code assignment runs CHUNKED through one small reusable float scratch, so
+  neither ``compress`` path materializes an input-sized temporary — the codecs
+  accept ``allow_inplace`` for API parity but never need to mutate the input
+  (a strictly stronger guarantee than in-place staging);
+- wire buffers are assembled with ONE allocation + slice writes (no bytes
+  concatenation of multi-MB payloads);
+- the jitted jax equivalents remain in :mod:`hivemind_tpu.ops.quantization` /
+  ``ops.pallas_quantization`` for callers that want the math on-device; the
+  numpy and jax paths share formulas (6σ uniform buckets with bucket-mean
+  codebooks, per-4096-block absmax int8) but are not bit-identical to each
+  other — a codec instance is deterministic within a process, which is what
+  the wire-equivalence suite pins.
+"""
 
 from __future__ import annotations
 
 import struct
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 import numpy as np
 
@@ -18,17 +36,111 @@ from hivemind_tpu.compression.base import (
 )
 from hivemind_tpu.ops.quantization import (
     BLOCKWISE_BLOCK_SIZE,
-    blockwise_quantize,
-    dequantize_with_codebook,
+    UNIFORM_NUM_BUCKETS,
+    UNIFORM_RANGE_IN_SIGMAS,
+    hash_sample_indices,
     pad_to_block,
     quantile_quantize,
-    uniform_quantize,
 )
 from hivemind_tpu.proto import runtime_pb2
+
+# rint/clip/cast staging chunk: big enough that the python loop is noise
+# (≤ a handful of iterations per 2 MiB part), small enough to stay cache-warm
+_CODE_CHUNK = 1 << 18
+
+# statistics (mean/std + bucket-mean codebook) come from a bounded
+# layout-independent sample past this size — 512 samples per bucket keeps the
+# bucket-mean standard error far inside one bucket width while the only
+# full-array work left is code assignment (the weighted bincount the codebook
+# used to need is ~8 ms per 2 MiB part — the dominant codec cost). Sampling is
+# the same deterministic multiplicative hash the quantile codec uses, so wire
+# bytes stay reproducible.
+_STATS_SAMPLE = 1 << 17
+
+
+def _stats_indices(size: int) -> Optional[np.ndarray]:
+    """Hash-sample indices for codebook statistics, or None (use everything)."""
+    if size <= _STATS_SAMPLE:
+        return None
+    return hash_sample_indices(size, _STATS_SAMPLE)
+
+
+def _assemble_wire(header_struct: Tuple[str, Tuple[int, ...]], *arrays: np.ndarray) -> bytes:
+    """One wire buffer from a packed header + raw array payloads with a single
+    allocation and slice writes — the lint-enforced alternative to chaining
+    ``struct.pack(...) + a.tobytes() + b.tobytes()`` (which copies the bulk
+    payload once per ``+``)."""
+    fmt, values = header_struct
+    header_size = struct.calcsize(fmt)
+    total = header_size + sum(a.nbytes for a in arrays)
+    wire = np.empty(total, np.uint8)
+    struct.pack_into(fmt, wire, 0, *values)
+    offset = header_size
+    for array in arrays:
+        wire[offset : offset + array.nbytes] = np.ascontiguousarray(array).view(np.uint8).reshape(-1)
+        offset += array.nbytes
+    return wire.tobytes()
+
+
+def _uniform_quantize_np(flat32: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Uniform 8-bit quantization over [mean − 6σ, mean + 6σ] with a bucket-mean
+    codebook (same formula as ``ops.quantization.uniform_quantize``), computed
+    without any input-sized temporary: codes are staged chunk-by-chunk through
+    one small scratch, then a single ``bincount`` over the untouched input
+    builds the codebook. The input is never mutated."""
+    if flat32.size == 0:
+        return np.zeros(0, np.uint8), np.zeros(UNIFORM_NUM_BUCKETS, np.float32)
+    indices = _stats_indices(flat32.size)
+    sample = flat32 if indices is None else flat32[indices]
+    mean = float(np.mean(sample))
+    std = float(np.std(sample)) + 1e-11
+    lo = mean - UNIFORM_RANGE_IN_SIGMAS * std
+    hi = mean + UNIFORM_RANGE_IN_SIGMAS * std
+    scale = (UNIFORM_NUM_BUCKETS - 1) / (hi - lo)
+    codes = np.empty(flat32.size, np.uint8)
+    scratch = np.empty(min(flat32.size, _CODE_CHUNK), np.float32)
+    for start in range(0, flat32.size, _CODE_CHUNK):
+        view = flat32[start : start + _CODE_CHUNK]
+        staged = scratch[: view.size]
+        np.subtract(view, np.float32(lo), out=staged)
+        np.multiply(staged, np.float32(scale), out=staged)
+        np.rint(staged, out=staged)
+        np.clip(staged, 0, UNIFORM_NUM_BUCKETS - 1, out=staged)
+        codes[start : start + _CODE_CHUNK] = staged  # cast-assign into the output
+    # bucket-mean codebook: average of the elements that landed in each bucket
+    # (estimated from the same bounded sample), midpoint fallback for empties
+    sample_codes = codes if indices is None else codes[indices]
+    sums = np.bincount(sample_codes, weights=sample, minlength=UNIFORM_NUM_BUCKETS)
+    counts = np.bincount(sample_codes, minlength=UNIFORM_NUM_BUCKETS)
+    midpoints = lo + (np.arange(UNIFORM_NUM_BUCKETS, dtype=np.float64) + 0.5) / scale
+    codebook = np.where(counts > 0, sums / np.maximum(counts, 1), midpoints)
+    return codes, codebook.astype(np.float32, copy=False)
+
+
+def _blockwise_quantize_np(padded32: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-4096-block absmax int8 (same formula as the jitted/pallas path),
+    numpy-only and temp-free: absmax via max/−min reductions (no |x| temp),
+    codes staged through one small row-chunk scratch. Input never mutated."""
+    blocks = padded32.reshape(-1, BLOCKWISE_BLOCK_SIZE)
+    absmax = np.maximum(blocks.max(axis=1), -blocks.min(axis=1))
+    scale = np.where(absmax > 0, 127.0 / absmax, 0.0).astype(np.float32, copy=False)
+    codes = np.empty(blocks.shape, np.int8)
+    rows_per_chunk = max(1, _CODE_CHUNK // BLOCKWISE_BLOCK_SIZE)
+    scratch = np.empty((min(blocks.shape[0], rows_per_chunk), BLOCKWISE_BLOCK_SIZE), np.float32)
+    for start in range(0, blocks.shape[0], rows_per_chunk):
+        view = blocks[start : start + rows_per_chunk]
+        staged = scratch[: view.shape[0]]
+        np.multiply(view, scale[start : start + rows_per_chunk, None], out=staged)
+        np.rint(staged, out=staged)
+        np.clip(staged, -127, 127, out=staged)
+        codes[start : start + rows_per_chunk] = staged
+    return codes, absmax.astype(np.float32, copy=False)
 
 
 class _CodebookQuantization(CompressionBase):
     """Shared wire format: [u32 codebook_size][fp32 codebook][u8 codes]."""
+
+    is_lossy = True
 
     def _quantize(self, flat32):
         raise NotImplementedError
@@ -38,8 +150,9 @@ class _CodebookQuantization(CompressionBase):
         original_dtype = "bfloat16" if str(array.dtype) == "bfloat16" else array.dtype.name
         flat = np.ascontiguousarray(array, dtype=np.float32).reshape(-1)
         codes, codebook = self._quantize(flat)
-        codes, codebook = np.asarray(codes), np.asarray(codebook)
-        buffer = struct.pack("<I", codebook.size) + codebook.astype(np.float32).tobytes() + codes.tobytes()
+        codes = np.asarray(codes, dtype=np.uint8)
+        codebook = np.asarray(codebook, dtype=np.float32)
+        buffer = _assemble_wire(("<I", (codebook.size,)), codebook, codes)
         return runtime_pb2.Tensor(
             buffer=buffer, size=array.shape, dtype=original_dtype, compression=self.compression_type
         )
@@ -50,8 +163,8 @@ class _CodebookQuantization(CompressionBase):
         (codebook_size,) = struct.unpack_from("<I", serialized.buffer)
         codebook = np.frombuffer(serialized.buffer, dtype=np.float32, count=codebook_size, offset=4)
         codes = np.frombuffer(serialized.buffer, dtype=np.uint8, offset=4 + codebook_size * 4)
-        restored = dequantize_with_codebook(codes, codebook)
-        return restored.astype(numpy_dtype(serialized.dtype or "float32")).reshape(tuple(serialized.size))
+        restored = codebook[codes.astype(np.int64, copy=False)]
+        return restored.astype(numpy_dtype(serialized.dtype or "float32"), copy=False).reshape(tuple(serialized.size))
 
     def estimate_compression_ratio(self, info: CompressionInfo) -> float:
         return 8.0 / (8 * (info.descriptor.itemsize if info.descriptor else 4))
@@ -61,52 +174,54 @@ class Uniform8BitQuantization(_CodebookQuantization):
     compression_type = CompressionType.UNIFORM_8BIT
 
     def _quantize(self, flat32):
-        return uniform_quantize(flat32)
+        return _uniform_quantize_np(flat32)
 
 
 class Quantile8BitQuantization(_CodebookQuantization):
+    """Codebook = 256 empirical quantiles, estimated from a bounded hash-sampled
+    subset past 2^20 elements so multi-M-element tensors never pay a full sort
+    on the codec path (ops/quantization.quantile_quantize; runtime bounded by a
+    regression test)."""
+
     compression_type = CompressionType.QUANTILE_8BIT
 
     def _quantize(self, flat32):
-        return quantile_quantize(flat32)
+        codes, codebook = quantile_quantize(flat32)
+        return codes, codebook
 
 
 class BlockwiseQuantization(CompressionBase):
     """Per-4096-block absmax int8 (reference quantization.py:130-201 via bitsandbytes;
-    here a fused Pallas kernel on TPU / fused-jnp on host — see
-    ops/pallas_quantization.py and ops/quantization.py for the deviation note).
+    numpy on the wire path, fused Pallas/jnp kernels in ops/ for device callers).
     Wire format: [u32 n_blocks][u32 true_size][fp32 absmax per block][i8 codes]."""
 
     compression_type = CompressionType.BLOCKWISE_8BIT
+    is_lossy = True
 
     def compress(self, array: Any, info: Optional[CompressionInfo] = None, allow_inplace: bool = False) -> runtime_pb2.Tensor:
         array = as_numpy(array)
         original_dtype = "bfloat16" if str(array.dtype) == "bfloat16" else array.dtype.name
         flat = np.ascontiguousarray(array, dtype=np.float32).reshape(-1)
         padded, true_size = pad_to_block(flat)
-        from hivemind_tpu.ops.pallas_quantization import blockwise_quantize_auto
-
-        codes, absmax = blockwise_quantize_auto(padded)
-        codes, absmax = np.asarray(codes), np.asarray(absmax)
-        buffer = (
-            struct.pack("<II", absmax.size, true_size)
-            + absmax.astype(np.float32).tobytes()
-            + codes.tobytes()
-        )
+        codes, absmax = _blockwise_quantize_np(padded)
+        buffer = _assemble_wire(("<II", (absmax.size, true_size)), absmax, codes)
         return runtime_pb2.Tensor(
             buffer=buffer, size=array.shape, dtype=original_dtype, compression=self.compression_type
         )
 
     def extract(self, serialized: runtime_pb2.Tensor) -> np.ndarray:
-        from hivemind_tpu.ops.pallas_quantization import blockwise_dequantize_auto
         from hivemind_tpu.utils.tensor_descr import numpy_dtype
 
         n_blocks, true_size = struct.unpack_from("<II", serialized.buffer)
         absmax = np.frombuffer(serialized.buffer, dtype=np.float32, count=n_blocks, offset=8)
         codes = np.frombuffer(serialized.buffer, dtype=np.int8, offset=8 + n_blocks * 4)
-        codes = codes.reshape(n_blocks, -1)
-        restored = np.asarray(blockwise_dequantize_auto(codes, absmax))[:true_size]
-        return restored.astype(numpy_dtype(serialized.dtype or "float32")).reshape(tuple(serialized.size))
+        if n_blocks == 0:  # zero-element tensor: reshape(0, -1) would raise
+            restored = np.zeros(0, np.float32)
+        else:
+            restored = codes.astype(np.float32, copy=True).reshape(n_blocks, -1)
+            np.multiply(restored, (absmax / np.float32(127.0))[:, None], out=restored)
+            restored = restored.reshape(-1)[:true_size]
+        return restored.astype(numpy_dtype(serialized.dtype or "float32"), copy=False).reshape(tuple(serialized.size))
 
     def estimate_compression_ratio(self, info: CompressionInfo) -> float:
         return 8.25 / (8 * (info.descriptor.itemsize if info.descriptor else 4))

@@ -101,6 +101,7 @@ def render_frame(
 
     alerts: List[str] = []
     straggler_board: Dict[str, Dict[str, float]] = {}
+    link_tiers: Dict[str, str] = {}  # victim -> last-reported wire tier (ISSUE 11)
 
     def _render_peer(peer: str, snapshot: Dict[str, Any]) -> None:
         age = max(now - float(snapshot.get("time", now)), 0.0)
@@ -151,6 +152,20 @@ def render_frame(
             board["excess_s"] = round(board["excess_s"] + float(score.get("excess_s", 0.0)), 3)
             board["reporters"] += 1
 
+        # per-link negotiated wire tiers (records are oldest→newest: latest wins)
+        # and demote/promote decisions from the adaptive codec policy
+        for record in ledger.get("records") or ():
+            codecs = record.get("link_codecs") if isinstance(record, dict) else None
+            if isinstance(codecs, dict):
+                for victim, tier in codecs.items():
+                    link_tiers[str(victim)] = str(tier)
+        for event in ledger.get("codec_events") or ():
+            if isinstance(event, dict):
+                alerts.append(
+                    f"{yellow}codec{reset} {peer[:16]}: {event.get('action')} "
+                    f"{str(event.get('peer'))[:16]} -> {event.get('tier') or 'default'}"
+                )
+
         if stalls and watchdog.get("last_stall"):
             last = watchdog["last_stall"]
             alerts.append(
@@ -195,9 +210,11 @@ def render_frame(
             key=lambda kv: (-kv[1]["rounds_slowest"], -kv[1]["excess_s"]),
         )
         for victim, score in ranked[:8]:
+            tier = link_tiers.get(victim)
             lines.append(
                 f"  {victim[:18]:<18} slowest in {score['rounds_slowest']:>4} round(s), "
                 f"+{score['excess_s']:.3f}s excess, reported by {score['reporters']} peer(s)"
+                + (f", link @{tier}" if tier else "")
             )
 
     if alerts:

@@ -67,6 +67,20 @@ def uniform_quantize(flat: jax.Array):
 QUANTILE_SAMPLE_SIZE = 1 << 20  # codebook estimation sample for large tensors
 
 
+def hash_sample_indices(size: int, count: int) -> np.ndarray:
+    """``count`` layout-independent sample indices into a flat array of
+    ``size`` via a multiplicative-hash sequence (Knuth's 2654435761): unlike
+    strided sampling, the indices share no period with any channel layout, so
+    structured tensors cannot alias the sample onto a single column. THE shared
+    sampler for every host-side codec statistic (quantile codebooks here,
+    uniform8 codebooks in compression/quantization.py) — one formula, so the
+    'deterministic, reproducible wire bytes' guarantee cannot drift apart."""
+    indices = (
+        np.arange(count, dtype=np.uint64) * np.uint64(2654435761)
+    ) % np.uint64(size)
+    return indices.astype(np.int64, copy=False)
+
+
 @jax.jit
 def _quantile_codebook(flat32: jax.Array) -> jax.Array:
     quantiles = jnp.linspace(0.5 / UNIFORM_NUM_BUCKETS, 1 - 0.5 / UNIFORM_NUM_BUCKETS, UNIFORM_NUM_BUCKETS)
@@ -113,11 +127,7 @@ def quantile_quantize(flat: jax.Array):
     if flat32.size == 0:
         return np.zeros(0, np.uint8), np.zeros(UNIFORM_NUM_BUCKETS, np.float32)
     if flat32.size > QUANTILE_SAMPLE_SIZE:
-        # layout-independent multiplicative-hash sample (see _quantile_sample)
-        indices = (
-            np.arange(QUANTILE_SAMPLE_SIZE, dtype=np.uint64) * np.uint64(2654435761)
-        ) % np.uint64(flat32.size)
-        sample = np.sort(flat32[indices.astype(np.int64)])
+        sample = np.sort(flat32[hash_sample_indices(flat32.size, QUANTILE_SAMPLE_SIZE)])
     else:
         sample = np.sort(flat32)
     # evenly spaced order statistics of the sorted sample = empirical quantiles

@@ -59,7 +59,7 @@ class PowerSGDGradientAverager(GradientAverager):
                 self._qs[i] = np.asarray(rng.randn(n, self.rank), np.float32)
 
     async def _aggregate_with_group(self, group_info: GroupInfo, weight: float):
-        bandwidths, modes, user_gathered = self._decode_gathered(group_info)
+        bandwidths, modes, user_gathered, _adverts = self._decode_gathered(group_info)
         with self.get_tensors() as tensors:
             local = [t.copy() for t in tensors]
 

@@ -303,6 +303,10 @@ class TrainingStateAverager(DecentralizedAverager):
             logger.warning(f"donor sent {len(result.tensors)} tensors, expected {expected}; ignoring")
             return False
         self._load_host_state_tensors(result.tensors)
+        # adopted tensors owe nothing to our pre-download quantization errors:
+        # carrying the old error-feedback residuals forward would "compensate"
+        # state we no longer hold (ISSUE 11)
+        self._wire_residuals.reset()
         # the verified manifest's epoch is authoritative; a legacy (unverified)
         # stream falls back to the msgpack metadata it shipped
         donor_epoch = int(result.epoch)

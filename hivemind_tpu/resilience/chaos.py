@@ -32,7 +32,9 @@ Actions: ``drop`` (raises :class:`ChaosDrop`, a ``ConnectionError`` — looks
 like the network ate it), ``delay`` (sleeps ``delay`` seconds), ``abort``
 (raises :class:`ChaosAbort`, a ``RuntimeError`` — looks like a peer crash or
 software fault), ``corrupt_payload`` (deterministically flips bytes in the
-payload when the point carries one).
+payload when the point carries one), ``throttle`` (sleeps
+``len(payload) / rate`` — a simulated bandwidth-limited WAN link; no-op at
+points that carry no payload).
 
 Activation: programmatically (``CHAOS.add_rule(...)`` / ``CHAOS.configure``)
 or via ``HIVEMIND_CHAOS`` at import, e.g.::
@@ -41,7 +43,8 @@ or via ``HIVEMIND_CHAOS`` at import, e.g.::
 
 Grammar: ``spec = segment (";" segment)*``; a segment is either ``seed=<int>``
 or ``<point>:<action>[:key=value]...`` with keys ``prob`` (default 1.0),
-``delay`` (seconds, default 0.1), ``after`` (skip the first N matching calls),
+``delay`` (seconds, default 0.1), ``rate`` (throttle bandwidth in bytes/s,
+default 125e6 ≈ 1 Gbps), ``after`` (skip the first N matching calls),
 ``times`` (max injections), ``scope`` (substring matched against the call
 site's scope). A point may end in ``*`` for prefix matching (``p2p.*``).
 """
@@ -73,7 +76,7 @@ INJECTION_POINTS = (
     "state.download.send", "state.download.recv",
 )
 
-ACTIONS = ("drop", "delay", "abort", "corrupt_payload")
+ACTIONS = ("drop", "delay", "abort", "corrupt_payload", "throttle")
 
 
 class ChaosError(Exception):
@@ -94,6 +97,7 @@ class ChaosRule:
     action: str
     prob: float = 1.0
     delay: float = 0.1
+    rate: float = 125e6  # throttle bandwidth, bytes/s (default ≈ 1 Gbps)
     after: int = 0
     times: Optional[int] = None
     scope: Optional[str] = None
@@ -149,6 +153,7 @@ class ChaosEngine:
         *,
         prob: float = 1.0,
         delay: float = 0.1,
+        rate: float = 125e6,
         after: int = 0,
         times: Optional[int] = None,
         scope: Optional[str] = None,
@@ -157,7 +162,7 @@ class ChaosEngine:
         if not point.endswith("*") and point not in INJECTION_POINTS:
             logger.warning(f"chaos rule targets unknown injection point {point!r}")
         rule = ChaosRule(
-            point=point, action=action, prob=prob, delay=delay, after=after,
+            point=point, action=action, prob=prob, delay=delay, rate=rate, after=after,
             times=times, scope=scope,
             rng=random.Random(_rule_seed(self._seed, len(self._rules), point, action)),
         )
@@ -186,7 +191,7 @@ class ChaosEngine:
             kwargs: Dict[str, object] = {}
             for kv in fields[2:]:
                 key, _, value = kv.partition("=")
-                if key in ("prob", "delay"):
+                if key in ("prob", "delay", "rate"):
                     kwargs[key] = float(value)
                 elif key in ("after", "times"):
                     kwargs[key] = int(value)
@@ -247,6 +252,16 @@ class ChaosEngine:
                 raise ChaosAbort(f"chaos: aborted at {point}")
             if rule.action == "delay":
                 await asyncio.sleep(rule.delay)
+            elif rule.action == "throttle":
+                # simulated bandwidth-limited link: pay the payload's wire time.
+                # Rules on the same link serialize naturally (the call site
+                # awaits inline), distinct links throttle independently.
+                try:
+                    size = len(payload) if payload is not None else 0
+                except TypeError:
+                    size = 0
+                if size and rule.rate > 0:
+                    await asyncio.sleep(size / rule.rate)
             elif rule.action == "corrupt_payload":
                 payload = self._corrupt(payload, rule.rng)
         return payload

@@ -93,6 +93,9 @@ class RoundLedger:
         self._records: "deque[Dict[str, Any]]" = deque(maxlen=capacity)
         self._epochs: "deque[Dict[str, Any]]" = deque(maxlen=epoch_capacity)
         self._straggler: Dict[str, Dict[str, float]] = {}
+        # adaptive link-codec demote/promote decisions (ISSUE 11), fed by the
+        # averager's LinkCodecPolicy — bounded ring, shown in hivemind-top
+        self._codec_events: "deque[Dict[str, Any]]" = deque(maxlen=64)
         # open-round buffers keyed by the allreduce.round span id
         self._pending_exchanges: Dict[int, List[Dict[str, Any]]] = {}
         self._pending_local: Dict[int, float] = {}
@@ -123,11 +126,16 @@ class RoundLedger:
         if name == "allreduce.peer_exchange":
             parent = span.parent_id
             if parent:
+                attrs = span.attributes or {}
                 info = {
-                    "remote": str((span.attributes or {}).get("remote", "?")),
+                    "remote": str(attrs.get("remote", "?")),
                     "dur_s": round(span.duration, 6),
                     "events": [n for _t, n, _a in span.events] if span.events else [],
                 }
+                if attrs.get("codec") is not None:
+                    # the negotiated wire tier of this link (ISSUE 11) — rides
+                    # the record so demotions are visible per round
+                    info["codec"] = str(attrs["codec"])
                 with self._lock:
                     if parent in self._closed_rounds:
                         self._attach_late_exchange(parent, info)
@@ -184,6 +192,13 @@ class RoundLedger:
                 for exchange in exchanges:
                     other = self._score(exchange["remote"])
                     other["total_s"] = round(other["total_s"] + exchange["dur_s"], 6)
+                link_codecs = {
+                    exchange["remote"]: exchange["codec"]
+                    for exchange in exchanges
+                    if "codec" in exchange
+                }
+                if link_codecs:
+                    record["link_codecs"] = link_codecs
             events = [n for _t, n, _a in span.events] if span.events else []
             for exchange in exchanges:
                 events.extend(exchange["events"])
@@ -279,6 +294,8 @@ class RoundLedger:
         already-assembled record and re-attribute the round. Lock held."""
         record = self._closed_rounds[round_id]
         record.setdefault("exchanges", []).append(info)
+        if "codec" in info:
+            record.setdefault("link_codecs", {})[info["remote"]] = info["codec"]
         score = self._score(info["remote"])
         score["total_s"] = round(score["total_s"] + info["dur_s"], 6)
         if info["events"]:
@@ -286,6 +303,26 @@ class RoundLedger:
             for event in info["events"]:
                 counts[event] = counts.get(event, 0) + 1
         self._apply_round_attribution(round_id, record)
+
+    def record_codec_event(self, peer: str, action: str, tier: Optional[str] = None) -> None:
+        """One adaptive link-codec decision (demote/promote, from the averager's
+        straggler policy): who, what, and to which tier."""
+        with self._lock:
+            self._codec_events.append(
+                {
+                    "time": round(time.time(), 3),
+                    "peer": str(peer),
+                    "action": str(action),
+                    "tier": tier,
+                }
+            )
+
+    def codec_events(self, limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            events = list(self._codec_events)
+            if limit:
+                events = events[-limit:]
+            return [dict(event) for event in events]
 
     def record_epoch(
         self,
@@ -331,7 +368,7 @@ class RoundLedger:
         out = dict(record)
         if "exchanges" in out:
             out["exchanges"] = [dict(exchange) for exchange in out["exchanges"]]
-        for nested in ("events", "counters"):
+        for nested in ("events", "counters", "link_codecs"):
             if nested in out:
                 out[nested] = dict(out[nested])
         return out
@@ -394,6 +431,9 @@ class RoundLedger:
         epochs = self.epochs(limit=max_records)
         if epochs:
             out["epochs"] = epochs
+        codec_events = self.codec_events(limit=max_stragglers)
+        if codec_events:
+            out["codec_events"] = codec_events
         return out
 
     def export(self) -> Dict[str, Any]:
@@ -402,6 +442,7 @@ class RoundLedger:
             "records": self.records(),
             "epochs": self.epochs(),
             "straggler_scores": self.straggler_scores(),
+            "codec_events": self.codec_events(),
             "summary": self.summary(),
         }
 
@@ -410,6 +451,7 @@ class RoundLedger:
             self._records.clear()
             self._epochs.clear()
             self._straggler.clear()
+            self._codec_events.clear()
             self._pending_exchanges.clear()
             self._pending_local.clear()
             self._closed_rounds.clear()

@@ -131,6 +131,17 @@ class Metric:
         assert not self.labelnames, f"{self.name} requires labels {self.labelnames}"
         return self.labels()
 
+    def remove(self, *labelvalues, **labelkwargs) -> None:
+        """Drop one label combination's series. For metrics whose label values
+        are swarm-supplied (peer ids), callers MUST bound cardinality by
+        evicting stale series — the registry itself keeps everything forever."""
+        if labelkwargs:
+            assert not labelvalues, "pass labels positionally or by keyword, not both"
+            labelvalues = tuple(labelkwargs[name] for name in self.labelnames)
+        key = tuple(str(v) for v in labelvalues)
+        with self._lock:
+            self._children.pop(key, None)
+
     def series(self) -> Iterable[Tuple[_LabelKey, object]]:
         with self._lock:
             return list(self._children.items())

@@ -203,6 +203,34 @@ def test_bench_artifact_embeds_ledger_and_watchdog_attribution():
     assert "attribution" not in full["extra"]["averaging_extra"]
 
 
+def test_benchmark_averaging_smoke_uniform8():
+    """ISSUE 11: the quantized averaging tier end-to-end in --smoke mode —
+    2 peers negotiate uniform8 links (with error-feedback residuals) through
+    the real DHT + matchmaking + butterfly path; any failed step exits nonzero,
+    so a quantized-wire regression fails tier-1 loudly. Mirrors the fp16 smoke
+    in test_partition_equivalence.py (bench.py's `_averaging_gbps_q8` runs the
+    same codec at the full 4-peer/4M config)."""
+    import subprocess
+    import sys
+
+    script = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks", "benchmark_averaging.py",
+    )
+    run = subprocess.run(
+        [sys.executable, script, "--smoke", "--compression", "uniform8"],
+        timeout=180,
+        capture_output=True,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert run.returncode == 0, f"smoke benchmark failed:\n{run.stdout[-2000:]}\n{run.stderr[-2000:]}"
+    payload = next(line for line in run.stdout.splitlines() if line.startswith("{"))
+    result = json.loads(payload)
+    assert result["extra"]["success_rate"] == 1.0
+    assert result["extra"]["compression"] == "uniform_8bit"
+
+
 def test_benchmark_llama_serving_smoke():
     """ISSUE 10: the serving data path end-to-end (checkpoint load + Server +
     RemoteSequential KV-cache decode over real RPC) — --smoke exits nonzero on

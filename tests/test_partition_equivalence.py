@@ -213,6 +213,98 @@ async def test_two_peer_allreduce_bit_identical_to_replay(codec_type):
         )
 
 
+async def _run_runner_group(flats, counts, links_by_peer, part_size_bytes=600):
+    """A real localhost all-reduce with hand-built per-peer link maps
+    (ISSUE 11); returns each peer's resulting vector."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_allreduce import _AllreduceHarness
+
+    from hivemind_tpu.averaging.residual import ResidualStore
+    from hivemind_tpu.compression import Float16Compression
+    from hivemind_tpu.p2p import P2P
+
+    n_peers = len(flats)
+    p2ps = [await P2P.create() for _ in range(n_peers)]
+    for i, p2p in enumerate(p2ps):
+        for other in p2ps[:i]:
+            await p2p.connect(other.get_visible_maddrs()[0])
+    harnesses = [_AllreduceHarness(p) for p in p2ps]
+    for harness in harnesses:
+        await harness.register()
+    try:
+        runners = []
+        for i in range(n_peers):
+            runner = AllReduceRunner(
+                p2p=p2ps[i],
+                group_id=b"tier-interop-group",
+                tensors=[flats[i].copy()],
+                ordered_peer_ids=[p.peer_id for p in p2ps],
+                peer_element_counts=counts,
+                modes=[AveragingMode.NODE] * n_peers,
+                get_stub=harnesses[i].get_stub,
+                compression=Float16Compression(),
+                part_size_bytes=part_size_bytes,
+                sender_timeout=10.0,
+                reducer_timeout=20.0,
+                links=links_by_peer[i],
+                residuals=ResidualStore(),
+            )
+            harnesses[i].runner = runner
+            runners.append(runner)
+
+        async def run_one(i):
+            return [d async for d in runners[i].run()]
+
+        all_deltas = await asyncio.gather(*(run_one(i) for i in range(n_peers)))
+    finally:
+        for p2p in p2ps:
+            await p2p.shutdown()
+    return [flats[i] + all_deltas[i][0].reshape(-1) for i in range(n_peers)]
+
+
+async def test_two_peer_quantized_allreduce_within_tolerance():
+    """The lossy-tier analog of the bit-identity replay: a uniform8 link with
+    error feedback (absolute_part delta leg) lands every peer within
+    quantization distance of the true average."""
+    from hivemind_tpu.averaging.wire_codec import WireLink
+
+    rng = np.random.RandomState(13)
+    n = 4000
+    flats = [rng.randn(n).astype(np.float32) for _ in range(2)]
+    counts = [n // 2, n - n // 2]
+    q8 = WireLink.for_tier("uniform8")
+    results = await _run_runner_group(flats, counts, [{1: q8}, {0: q8}])
+    true_average = (flats[0] + flats[1]) / 2
+    for i, result in enumerate(results):
+        assert np.abs(result - true_average).max() < 0.05, f"peer {i} diverged"
+
+
+async def test_mixed_tier_group_interop():
+    """ISSUE 11 satellite: an 8-bit peer in an fp16 group reduces correctly —
+    one link runs uniform8 (both directions, with EF) while the other two stay
+    float16; every peer still lands on the average within tolerance."""
+    from hivemind_tpu.averaging.wire_codec import WireLink
+
+    rng = np.random.RandomState(17)
+    n = 3000
+    flats = [rng.randn(n).astype(np.float32) for _ in range(3)]
+    counts = [1000, 1000, 1000]
+    q8, fp16 = WireLink.for_tier("uniform8"), WireLink.for_tier("float16")
+    # peer 2 is the "slow WAN" peer: its links run 8-bit; peers 0<->1 stay fp16
+    links_by_peer = [
+        {1: fp16, 2: q8},
+        {0: fp16, 2: q8},
+        {0: q8, 1: q8},
+    ]
+    results = await _run_runner_group(flats, counts, links_by_peer)
+    true_average = np.mean(flats, axis=0)
+    for i, result in enumerate(results):
+        assert np.abs(result - true_average).max() < 0.05, f"peer {i} diverged"
+    # the fp16-only link kept its classic delta path: peers 0 and 1 agree on
+    # each other's spans to fp16 precision
+    assert np.abs(results[0][:2000] - results[1][:2000]).max() < 2e-3
+
+
 def test_benchmark_averaging_smoke():
     """The throughput path end-to-end (DHT + matchmaking + butterfly all-reduce in
     subprocesses): --smoke must succeed on every step, so a data-path regression

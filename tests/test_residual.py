@@ -1,0 +1,280 @@
+"""Error-feedback residuals for the quantized averaging wire (ISSUE 11):
+ResidualStore semantics (accumulation, reset on schema/group change, no
+per-peer growth), the EF unbiasedness guarantee, and the convergence criterion
+— a decentralized-SGD recipe run lossless vs 8-bit+error-feedback through the
+REAL container/reducer/codec machinery reaches matched final loss."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from hivemind_tpu.averaging.partition import (
+    TensorPartContainer,
+    TensorPartReducer,
+    compute_span_part_sizes,
+)
+from hivemind_tpu.averaging.residual import ResidualStore, compress_with_feedback
+from hivemind_tpu.averaging.wire_codec import WireLink
+from hivemind_tpu.compression import (
+    CompressionType,
+    Float16Compression,
+    deserialize_tensor,
+    get_codec,
+    serialize_tensor,
+)
+
+
+# ------------------------------------------------------------------ store units
+
+
+def test_store_allocates_lazily_and_views_are_writable():
+    store = ResidualStore()
+    store.ensure(100)
+    assert store.footprint_bytes() == 0  # nothing until a lossy link touches it
+    view = store.view("send", 10, 20)
+    assert view.shape == (10,) and np.all(view == 0)
+    view += 1.0
+    assert np.all(store.view("send", 10, 20) == 1.0)  # same backing plane
+    assert store.footprint_bytes() == 100 * 4
+
+
+def test_store_resets_when_schema_changes():
+    """'Reset on group change': a different total element count means the
+    partition universe changed — stale offsets would compensate the wrong
+    elements, so all residual state is discarded."""
+    store = ResidualStore()
+    store.ensure(64)
+    store.view("send", 0, 64)[:] = 3.0
+    store.ensure(64)  # same schema: state survives (group RE-composition)
+    assert np.all(store.view("send", 0, 64) == 3.0)
+    store.ensure(128)  # schema changed: reset
+    assert store.footprint_bytes() == 0
+    assert np.all(store.view("send", 0, 128) == 0)
+
+
+def test_store_explicit_reset_and_no_per_peer_growth():
+    """No-leak on peer departure: residual memory is exactly two planes
+    (send + reduce), INDEPENDENT of how many peers come and go — there is no
+    per-peer buffer to leak."""
+    store = ResidualStore()
+    store.ensure(256)
+    for fake_peer in range(50):  # arbitrarily many groupmates over time
+        store.view("send", fake_peer, fake_peer + 1)
+        store.view("reduce", fake_peer, fake_peer + 1)
+    assert store.footprint_bytes() == 2 * 256 * 4
+    store.reset()
+    assert store.footprint_bytes() == 0
+
+
+def test_error_feedback_accumulation_is_unbiased():
+    """The EF contract: the time-average of what crosses the wire converges to
+    the true value — after R rounds the cumulative quantization error is ONE
+    round's residual, not a random walk of R errors."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(4096).astype(np.float32)
+    codec = get_codec(CompressionType.UNIFORM_8BIT)
+    residual = np.zeros(4096, np.float32)
+    rounds = 20
+    decoded_sum = np.zeros(4096, np.float64)
+    single_round_err = None
+    for _round in range(rounds):
+        serialized = compress_with_feedback(x, codec, residual)
+        decoded = deserialize_tensor(serialized)
+        if single_round_err is None:
+            single_round_err = float(np.abs(decoded - x).max())
+        decoded_sum += decoded
+    mean_err = float(np.abs(decoded_sum / rounds - x).max())
+    # telescoping: mean error ~ single_round/rounds; allow generous slack
+    assert mean_err < single_round_err / 3, (mean_err, single_round_err)
+    # and the residual itself stays bounded (one quantization step, not R)
+    assert float(np.abs(residual).max()) < 4 * single_round_err
+
+
+def test_compress_with_feedback_does_not_mutate_part():
+    rng = np.random.RandomState(1)
+    part = rng.randn(1000).astype(np.float32)
+    original = part.copy()
+    residual = np.zeros(1000, np.float32)
+    compress_with_feedback(part, get_codec(CompressionType.UNIFORM_8BIT), residual)
+    assert np.array_equal(part, original)
+    assert np.any(residual != 0)
+
+
+# ------------------------------------------------------------------ wire simulation
+
+PART_BYTES = 512
+
+
+async def _wire_average(peer_vectors, tier, stores):
+    """One butterfly round through the REAL TensorPartContainer /
+    TensorPartReducer / codec / residual machinery, in process: every
+    non-loopback part and every delta crosses the serialized wire format.
+    ``tier=None`` is the lossless fp16 path; a lossy tier engages error
+    feedback and the absolute-average delta leg, exactly like AllReduceRunner."""
+    peers = len(peer_vectors)
+    n = peer_vectors[0].size
+    counts = [n // peers] * peers
+    counts[-1] += n - sum(counts)
+    fp16 = Float16Compression()
+    link = WireLink.for_tier(tier) if tier else None
+    lossy = link is not None and link.error_feedback
+    containers = []
+    for i in range(peers):
+        peer_links = [link if j != i else None for j in range(peers)] if link else None
+        containers.append(
+            TensorPartContainer(
+                [peer_vectors[i]], counts, compression=fp16, part_size_bytes=PART_BYTES,
+                peer_links=peer_links, residuals=stores[i] if lossy else None,
+            )
+        )
+    for owner in range(peers):
+        if lossy:
+            stores[owner].ensure(n)
+        part_sizes = compute_span_part_sizes(counts[owner], PART_BYTES)
+        reducer = TensorPartReducer([(size,) for size in part_sizes], num_senders=peers)
+        arrived = {}
+        for sender in range(peers):
+            if sender == owner:
+                arrived[sender] = containers[sender].get_raw_input_parts(owner)
+            else:
+                serialized = [s async for s in containers[sender].iterate_input_parts_for(owner)]
+                arrived[sender] = [deserialize_tensor(s) for s in serialized]
+        span_start = sum(counts[:owner])
+        offset = 0
+        for part_index, size in enumerate(part_sizes):
+            averaged = (
+                await asyncio.gather(
+                    *(
+                        reducer.accumulate_part(sender, part_index, arrived[sender][part_index])
+                        for sender in range(peers)
+                    )
+                )
+            )[0]
+            if lossy:
+                residual = stores[owner].view(
+                    "reduce", span_start + offset, span_start + offset + size
+                )
+                payload = compress_with_feedback(averaged, link.codec, residual)
+                decoded = deserialize_tensor(payload)
+                for sender in range(peers):
+                    if sender == owner:
+                        containers[owner].register_processed_part(
+                            owner, part_index, averaged - arrived[owner][part_index]
+                        )
+                    else:
+                        containers[sender].register_processed_absolute(owner, part_index, decoded)
+            else:
+                for sender in range(peers):
+                    delta = averaged - arrived[sender][part_index]
+                    if sender == owner:
+                        containers[owner].register_processed_part(owner, part_index, delta)
+                    else:
+                        wire_delta = deserialize_tensor(serialize_tensor(delta.copy(), fp16))
+                        containers[sender].register_processed_part(owner, part_index, wire_delta)
+            offset += size
+    averaged_vectors = []
+    for i in range(peers):
+        deltas = [d async for d in containers[i].iterate_output_tensors()]
+        averaged_vectors.append(peer_vectors[i] + deltas[0].reshape(-1))
+    return averaged_vectors
+
+
+async def test_mixed_container_lossless_parts_stay_bit_identical():
+    """A container with one lossy link must serialize its LOSSLESS peers'
+    parts byte-identically to the no-negotiation path."""
+    rng = np.random.RandomState(3)
+    tensors = [rng.randn(900).astype(np.float32)]
+    counts = [300, 300, 300]
+    fp16 = Float16Compression()
+    store = ResidualStore()
+    links = [None, WireLink.for_tier("float16"), WireLink.for_tier("uniform8")]
+    container = TensorPartContainer(
+        [tensors[0].copy()], counts, compression=fp16, part_size_bytes=PART_BYTES,
+        peer_links=links, residuals=store,
+    )
+    baseline = TensorPartContainer(
+        [tensors[0].copy()], counts, compression=fp16, part_size_bytes=PART_BYTES
+    )
+    for peer_index in (0, 1):  # None-link and explicit float16 link
+        got = [s async for s in container.iterate_input_parts_for(peer_index)]
+        expected = [s async for s in baseline.iterate_input_parts_for(peer_index)]
+        assert [g.SerializeToString() for g in got] == [e.SerializeToString() for e in expected]
+    # the lossy peer's parts decode within quantization tolerance, with EF armed
+    lossy_parts = [s async for s in container.iterate_input_parts_for(2)]
+    decoded = np.concatenate([deserialize_tensor(s) for s in lossy_parts])
+    assert np.abs(decoded - tensors[0][600:]).max() < 0.2
+    assert store.footprint_bytes() > 0
+
+
+async def test_quantized_round_matches_lossless_within_tolerance():
+    rng = np.random.RandomState(7)
+    peer_vectors = [rng.randn(1000).astype(np.float32) for _ in range(3)]
+    true_average = np.mean(peer_vectors, axis=0)
+    stores = [ResidualStore() for _ in range(3)]
+    quantized = await _wire_average([v.copy() for v in peer_vectors], "uniform8", stores)
+    for result in quantized:
+        assert np.abs(result - true_average).max() < 0.05
+    # the quantized all-gather leg is near-consensus: peers disagree only by
+    # the span owner's unquantized advantage plus fp32 rounding, never by an
+    # accumulated drift
+    assert np.abs(quantized[0] - quantized[1]).max() < 0.05
+
+
+async def test_convergence_quantized_with_feedback_matches_lossless():
+    """The ISSUE 11 convergence criterion: a tiny decentralized-SGD recipe
+    (least squares, gradients averaged through the wire every step) reaches the
+    same final loss with 8-bit+error-feedback as with the lossless tier."""
+    peers, dim, samples, steps, lr = 2, 24, 48, 30, 0.15
+    rng = np.random.RandomState(11)
+    data = [
+        (rng.randn(samples, dim).astype(np.float32),
+         rng.randn(samples).astype(np.float32))
+        for _ in range(peers)
+    ]
+
+    def global_loss(w):
+        return float(
+            np.mean([np.mean((a @ w - b) ** 2) for a, b in data])
+        )
+
+    async def train(tier):
+        stores = [ResidualStore() for _ in range(peers)]
+        weights = [np.zeros(dim, np.float32) for _ in range(peers)]
+        for _step in range(steps):
+            grads = [
+                (2.0 / samples) * (a.T @ (a @ w - b))
+                for (a, b), w in zip(data, weights)
+            ]
+            averaged = await _wire_average(
+                [g.astype(np.float32) for g in grads], tier, stores
+            )
+            weights = [
+                (w - lr * g).astype(np.float32) for w, g in zip(weights, averaged)
+            ]
+        return global_loss(weights[0])
+
+    lossless = await train(None)
+    quantized = await train("uniform8")
+    assert quantized == pytest.approx(lossless, rel=0.02), (lossless, quantized)
+
+
+# ------------------------------------------------------------------ quantile runtime
+
+
+def test_quantile_compress_runtime_is_bounded():
+    """ISSUE 11 satellite: Quantile8BitQuantization estimates its codebook from
+    a bounded hash sample — a multi-M-element tensor must never pay a full-array
+    sort/np.quantile on the codec path. Regression bound: 16M elements well
+    under 2.5 s (the sampled path measures ~0.6 s on this host; a full-sort or
+    per-quantile implementation blows past the bound many times over)."""
+    codec = get_codec(CompressionType.QUANTILE_8BIT)
+    x = np.random.RandomState(0).randn(16_000_000).astype(np.float32)
+    started = time.perf_counter()
+    serialized = codec.compress(x)
+    elapsed = time.perf_counter() - started
+    assert elapsed < 2.5, f"quantile compress took {elapsed:.2f}s for 16M elements"
+    decoded = deserialize_tensor(serialized)
+    # sanity: the bounded sample still yields a usable codebook
+    assert float(np.abs(decoded - x).mean()) < 0.05

@@ -258,6 +258,27 @@ async def test_chaos_after_times_scope_and_corrupt():
     assert await engine.inject("p2p.unary.send", payload={"not": "bytes"}) == {"not": "bytes"}
 
 
+async def test_chaos_throttle_is_byte_proportional():
+    """ISSUE 11: the `throttle` action models a bandwidth-limited link — sleep
+    time scales with the payload's wire size; payload-free points are no-ops."""
+    import time as _time
+
+    engine = ChaosEngine()
+    engine.add_rule("allreduce.load", "throttle", rate=1_000_000.0)  # 1 MB/s
+    started = _time.perf_counter()
+    payload = b"\x00" * 100_000  # 0.1 s at 1 MB/s
+    returned = await engine.inject("allreduce.load", payload=payload)
+    elapsed = _time.perf_counter() - started
+    assert returned is payload  # throttle never alters bytes
+    assert 0.08 < elapsed < 1.0, elapsed
+    started = _time.perf_counter()
+    await engine.inject("allreduce.load")  # no payload: no sleep
+    assert _time.perf_counter() - started < 0.05
+    # grammar: rate is parseable from HIVEMIND_CHAOS specs
+    engine.configure("allreduce.reduce:throttle:rate=2e6")
+    assert engine.rules[0].rate == 2e6
+
+
 async def test_chaos_bad_specs_rejected():
     engine = ChaosEngine()
     with pytest.raises(ValueError):

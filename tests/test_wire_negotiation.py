@@ -1,0 +1,237 @@
+"""Per-link wire-codec negotiation + adaptive straggler demotion (ISSUE 11):
+the pure negotiation function, the advert wire format (incl. legacy gather
+blobs), the ledger-driven demote/promote policy, and the acceptance demo — a
+chaos-delayed link negotiates down to 8-bit while fast links stay at fp16."""
+
+import numpy as np
+
+from hivemind_tpu.averaging.wire_codec import (
+    EF_TIERS,
+    WIRE_TIERS,
+    LinkCodecPolicy,
+    WireLink,
+    make_advert,
+    negotiate_link,
+    parse_advert,
+    tier_of_codec,
+    tier_rank,
+)
+from hivemind_tpu.compression import (
+    BlockwiseQuantization,
+    Float16Compression,
+    NoCompression,
+    ScaledFloat16Compression,
+    Uniform8BitQuantization,
+)
+
+
+# ------------------------------------------------------------------ negotiation
+
+
+def test_tier_ladder_and_codec_mapping():
+    assert WIRE_TIERS == ("none", "float16", "uniform8", "blockwise8")
+    assert tier_of_codec(NoCompression()) == "none"
+    assert tier_of_codec(Float16Compression()) == "float16"
+    assert tier_of_codec(Uniform8BitQuantization()) == "uniform8"
+    assert tier_of_codec(BlockwiseQuantization()) == "blockwise8"
+    # codecs off the ladder disable negotiation rather than breaking it
+    assert tier_of_codec(ScaledFloat16Compression()) is None
+    for tier in WIRE_TIERS:
+        link = WireLink.for_tier(tier)
+        assert link.error_feedback == (tier in EF_TIERS)
+        assert tier_of_codec(link.codec) == tier
+
+
+def test_negotiate_defaults_match_configured_codec():
+    """No demotions → the link runs at the shared default tier (the exact
+    pre-negotiation behavior, which the bit-identity suite relies on)."""
+    a = parse_advert(make_advert(WIRE_TIERS, "float16", {}))
+    b = parse_advert(make_advert(WIRE_TIERS, "float16", {}))
+    assert negotiate_link(a, b, "peerA", "peerB") == "float16"
+
+
+def test_negotiate_demotion_is_symmetric():
+    """A demoting B (or vice versa) lands BOTH directions on the demoted tier:
+    each endpoint evaluates the same pure function over the same two adverts."""
+    demoting = parse_advert(make_advert(WIRE_TIERS, "float16", {"peerB": "uniform8"}))
+    plain = parse_advert(make_advert(WIRE_TIERS, "float16", {}))
+    # A's view of the A<->B link and B's view of the same link must agree
+    assert negotiate_link(demoting, plain, "peerA", "peerB") == "uniform8"
+    assert negotiate_link(plain, demoting, "peerB", "peerA") == "uniform8"
+    # the demotion names peerB specifically: a third peer is unaffected
+    assert negotiate_link(demoting, plain, "peerA", "peerC") == "float16"
+
+
+def test_negotiate_clamps_to_common_tiers():
+    """A proposal the other side does not support clamps down to the best
+    mutually supported tier at or below the proposal."""
+    wants_q8 = parse_advert(make_advert(WIRE_TIERS, "float16", {"peerB": "uniform8"}))
+    only_fp = parse_advert(make_advert(("none", "float16"), "float16", {}))
+    assert negotiate_link(wants_q8, only_fp, "peerA", "peerB") == "float16"
+
+
+def test_negotiate_requires_both_adverts():
+    advert = parse_advert(make_advert(WIRE_TIERS, "float16", {}))
+    assert negotiate_link(advert, None, "a", "b") is None
+    assert negotiate_link(None, advert, "a", "b") is None
+
+
+def test_parse_advert_rejects_malformed():
+    """Adverts are remote-controlled: anything malformed parses to None (the
+    link falls back to the configured codec), never an exception."""
+    assert parse_advert(None) is None
+    assert parse_advert("float16") is None
+    assert parse_advert({"t": "float16", "d": "float16"}) is None  # t not a list
+    assert parse_advert({"t": ["float16"], "d": "uniform8"}) is None  # default unsupported
+    assert parse_advert({"t": ["bogus"], "d": "bogus"}) is None
+    parsed = parse_advert({"t": ["float16", "bogus"], "d": "float16", "m": {"p": "nope", 3: "x"}})
+    assert parsed == {"t": ("float16",), "d": "float16", "m": {}}
+
+
+def test_advert_survives_msgpack_roundtrip():
+    from hivemind_tpu.utils.serializer import MSGPackSerializer
+
+    advert = make_advert(WIRE_TIERS, "float16", {"peerS": "uniform8"})
+    blob = MSGPackSerializer.dumps([1.0e8, 0, None, advert])
+    decoded = MSGPackSerializer.loads(blob)
+    assert parse_advert(decoded[3])["m"] == {"peerS": "uniform8"}
+    # legacy 3-slot gather blobs (pre-ISSUE-11 peers) simply carry no advert
+    legacy = MSGPackSerializer.loads(MSGPackSerializer.dumps([1.0e8, 0, None]))
+    assert len(legacy) == 3
+
+
+# ------------------------------------------------------------------ policy
+
+
+class _ScriptedLedger:
+    """Stands in for the RoundLedger: scripted cumulative straggler scores."""
+
+    def __init__(self):
+        self.scores = {}
+        self.events = []
+
+    def bump(self, peer, slowest=0, excess=0.0):
+        entry = self.scores.setdefault(peer, {"rounds_slowest": 0, "excess_s": 0.0})
+        entry["rounds_slowest"] += slowest
+        entry["excess_s"] += excess
+
+    def straggler_scores(self):
+        return {peer: dict(score) for peer, score in self.scores.items()}
+
+    def record_codec_event(self, peer, action, tier=None):
+        self.events.append((peer, action, tier))
+
+
+def test_policy_demotes_chronic_straggler_and_promotes_after_clean_streak():
+    ledger = _ScriptedLedger()
+    policy = LinkCodecPolicy(
+        ledger, demote_rounds=3, min_excess_s=0.1, promote_after=4
+    )
+    # noise: slowest sometimes but with negligible excess — never demoted
+    for _ in range(6):
+        ledger.bump("noisy", slowest=1, excess=0.01)
+        assert policy.refresh() == {}
+    # chronic: three slowest rounds with real excess
+    for _ in range(2):
+        ledger.bump("slow", slowest=1, excess=0.4)
+        assert "slow" not in policy.refresh()
+    ledger.bump("slow", slowest=1, excess=0.4)
+    assert policy.refresh() == {"slow": "uniform8"}
+    assert ("slow", "demote", "uniform8") in ledger.events
+    # stays demoted while evidence keeps arriving
+    ledger.bump("slow", slowest=1, excess=0.4)
+    assert "slow" in policy.refresh()
+    # promotion: promote_after consecutive refreshes with no slow+excess rounds
+    for i in range(4):
+        demoted = policy.refresh()
+    assert demoted == {}
+    assert ("slow", "promote", None) in ledger.events
+
+
+def test_policy_retro_attribution_deltas_clamped_and_forget_drops_state():
+    ledger = _ScriptedLedger()
+    policy = LinkCodecPolicy(ledger, demote_rounds=2, min_excess_s=0.1)
+    ledger.bump("p", slowest=2, excess=0.5)
+    policy.refresh()
+    # ledger retro-attribution MOVED credit away: totals decreased
+    ledger.scores["p"]["rounds_slowest"] = 1
+    ledger.scores["p"]["excess_s"] = 0.1
+    policy.refresh()  # negative deltas clamp to zero, no crash
+    policy.forget("p")
+    assert policy.demotions() == {}
+
+
+def test_policy_bounds_tracked_peers():
+    ledger = _ScriptedLedger()
+    policy = LinkCodecPolicy(ledger, max_peers=8)
+    for index in range(50):
+        ledger.bump(f"peer{index}", slowest=1, excess=0.0)
+        policy.refresh()
+    assert len(policy._last_seen) <= 8
+
+
+# ------------------------------------------------------------------ acceptance demo
+
+
+def test_chaos_slow_link_negotiates_down_to_8bit():
+    """The acceptance criterion end-to-end: a chaos `delay` rule on one peer's
+    delta leg makes every exchange WITH that peer chronically slow; the other
+    peers' straggler policies demote it, and the next rounds' ledger records
+    show that link at uniform8 while the fast link stays at float16."""
+    from hivemind_tpu.averaging import DecentralizedAverager
+    from hivemind_tpu.dht import DHT
+    from hivemind_tpu.resilience import CHAOS
+    from hivemind_tpu.telemetry.ledger import LEDGER
+
+    first = DHT(start=True)
+    maddrs = [str(m) for m in first.get_visible_maddrs()]
+    dhts = [first] + [DHT(initial_peers=maddrs, start=True) for _ in range(2)]
+    averagers = []
+    try:
+        for i, dht in enumerate(dhts):
+            rng = np.random.RandomState(i)
+            averagers.append(
+                DecentralizedAverager(
+                    [rng.randn(2000).astype(np.float32)], dht, prefix="adaptive",
+                    start=True, target_group_size=3, min_matchmaking_time=1.0,
+                    compression=Float16Compression(),
+                    link_policy=LinkCodecPolicy(
+                        demote_rounds=2, min_excess_s=0.1, promote_after=50
+                    ),
+                )
+            )
+        slow = averagers[2]
+        slow_id = str(slow.peer_id)
+        fast_ids = {str(a.peer_id) for a in averagers[:2]}
+        # the slow peer serves its reduction deltas slowly — a bandwidth-starved
+        # WAN reducer; every exchange WITH it stretches, fast links don't
+        CHAOS.add_rule("allreduce.reduce", "delay", delay=0.4, scope=slow_id)
+
+        demoted_record = None
+        for _round in range(8):
+            controls = [a.step(wait=False, timeout=30) for a in averagers]
+            for control in controls:
+                control.result(timeout=45)
+            for record in LEDGER.records():
+                codecs = record.get("link_codecs") or {}
+                if record["peer"] in fast_ids and codecs.get(slow_id) == "uniform8":
+                    demoted_record = record
+            if demoted_record is not None:
+                break
+        assert demoted_record is not None, (
+            f"slow link never negotiated down; records: {LEDGER.records()}"
+        )
+        # the fast<->fast link in the same record stayed at fp16
+        fast_remote = next(pid for pid in fast_ids if pid != demoted_record["peer"])
+        assert demoted_record["link_codecs"].get(fast_remote) == "float16"
+        # and the decision itself is on the ledger's event ring
+        assert any(
+            event["action"] == "demote" and event["peer"] == slow_id
+            for event in LEDGER.codec_events()
+        )
+    finally:
+        CHAOS.clear()
+        for averager in averagers:
+            averager.shutdown()
+        for dht in dhts:
+            dht.shutdown()

@@ -44,6 +44,8 @@ HOT_FILES = (
     "p2p/crypto_channel.py",
     "averaging/partition.py",
     "averaging/allreduce.py",
+    "averaging/residual.py",
+    "compression/quantization.py",
     "moe/client/expert.py",
     "moe/server/connection_handler.py",
     "moe/server/task_pool.py",

@@ -39,6 +39,10 @@ FIELD_ADDITIONS = [
     ("DownloadRequest", "manifest_only", 2, F.LABEL_OPTIONAL, F.TYPE_BOOL, None),
     ("DownloadData", "manifest", 3, F.LABEL_OPTIONAL, F.TYPE_MESSAGE, ".hivemind_tpu.StateManifest"),
     ("DownloadData", "tensor_index", 4, F.LABEL_OPTIONAL, F.TYPE_UINT32, None),
+    # quantized delta leg (ISSUE 11): tensor_part carries the reduced average
+    # of this part (quantized once with reducer-side error feedback) instead of
+    # a per-sender delta; the sender subtracts its own input locally
+    ("AveragingData", "absolute_part", 6, F.LABEL_OPTIONAL, F.TYPE_BOOL, None),
 ]
 
 # (message name, [(field name, number, label, type, type_name), ...])
