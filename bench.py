@@ -84,21 +84,19 @@ def _tpu_probe(attempts: int = 3, timeout: float = 120.0):
     return False, errors
 
 
-def _averaging_gbps(timeout: float = 420.0, compression: str = "FLOAT16"):
-    """Second driver metric: butterfly all-reduce GB/s/peer (CPU/network-bound, does
-    not need the TPU). Run in a subprocess so a swarm hang can't take down the bench."""
+def _run_driver_json(script_name: str, argv: list, timeout: float, env: dict = None):
+    """Run one benchmarks/ driver in a subprocess (a hang can't take down the
+    bench) and harvest its first JSON stdout line; None on any failure."""
     import os
     import subprocess
     import sys
 
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "benchmarks", "benchmark_averaging.py")
+                          "benchmarks", script_name)
     try:
         run = subprocess.run(
-            [sys.executable, script, "--num_peers", "4", "--target_group_size", "4",
-             "--num_rounds", "3", "--num_params", "4000000",
-             "--min_matchmaking_time", "1.0", "--compression", compression],
-            timeout=timeout, capture_output=True, text=True,
+            [sys.executable, script, *argv],
+            timeout=timeout, capture_output=True, text=True, env=env,
         )
         for line in run.stdout.splitlines():
             line = line.strip()
@@ -107,6 +105,18 @@ def _averaging_gbps(timeout: float = 420.0, compression: str = "FLOAT16"):
     except (subprocess.TimeoutExpired, json.JSONDecodeError, OSError):
         pass
     return None
+
+
+def _averaging_gbps(timeout: float = 420.0, compression: str = "FLOAT16"):
+    """Second driver metric: butterfly all-reduce GB/s/peer (CPU/network-bound,
+    does not need the TPU)."""
+    return _run_driver_json(
+        "benchmark_averaging.py",
+        ["--num_peers", "4", "--target_group_size", "4", "--num_rounds", "3",
+         "--num_params", "4000000", "--min_matchmaking_time", "1.0",
+         "--compression", compression],
+        timeout,
+    )
 
 
 def _averaging_gbps_q8(timeout: float = 420.0):
@@ -119,27 +129,30 @@ def _averaging_gbps_q8(timeout: float = 420.0):
 def _llama_serving(timeout: float = 420.0):
     """Third driver metric: Petals-style checkpoint-served KV-cache decode tok/s
     (CPU-bound RPC + device dispatch, does not need the TPU), carrying the
-    serving-attribution summary (ISSUE 9) in its extra. Subprocess so a serving
-    hang can't take down the bench."""
-    import os
-    import subprocess
-    import sys
+    serving-attribution summary (ISSUE 9) in its extra."""
+    return _run_driver_json(
+        "benchmark_llama_serving.py",
+        ["--platform", "cpu", "--hidden_dim", "256", "--inner", "704",
+         "--layers", "2", "--generate", "32"],
+        timeout,
+    )
 
-    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "benchmarks", "benchmark_llama_serving.py")
-    try:
-        run = subprocess.run(
-            [sys.executable, script, "--platform", "cpu", "--hidden_dim", "256",
-             "--inner", "704", "--layers", "2", "--generate", "32"],
-            timeout=timeout, capture_output=True, text=True,
-        )
-        for line in run.stdout.splitlines():
-            line = line.strip()
-            if line.startswith("{"):
-                return json.loads(line)
-    except (subprocess.TimeoutExpired, json.JSONDecodeError, OSError):
-        pass
-    return None
+
+def _swarm_sim(timeout: float = 420.0):
+    """Fourth driver metric (ISSUE 12): the in-process swarm simulator's scale
+    numbers — peers simulated, sim-seconds per wall-second, beam-search routing
+    recall@beam vs the oracle, and same-seed determinism. Pure CPU + virtual
+    clock; the bench config is a mid-size soak (the full 1k-peer/10k-expert
+    acceptance run lives in the slow chaos suite)."""
+    import os
+
+    return _run_driver_json(
+        "benchmark_swarm_sim.py",
+        ["--scenario", "soak", "--peers", "300", "--grid", "8", "8", "40",
+         "--beam_size", "8", "--trials", "4"],
+        timeout,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
 
 
 def measure_main(force_cpu: bool = False) -> dict:
@@ -405,12 +418,12 @@ def _probe_point(label: str, probe_log: list, attempts: int) -> bool:
 _COMPACT_EXTRA_KEYS = (
     "device", "mfu", "batch_size", "remat", "seq_len", "final_loss",
     "attention", "masked_loss_fraction", "averaging_gbps_per_peer",
-    "averaging_gbps_q8_per_peer",
+    "averaging_gbps_q8_per_peer", "swarm_sim",
 )
 # least-important-first drop order when the compact line must shrink to fit
 _COMPACT_DROP_ORDER = (
-    "tpu_probes", "masked_loss_fraction", "attention", "final_loss", "remat",
-    "batch_size", "seq_len", "device", "averaging_gbps_q8_per_peer",
+    "tpu_probes", "swarm_sim", "masked_loss_fraction", "attention", "final_loss",
+    "remat", "batch_size", "seq_len", "device", "averaging_gbps_q8_per_peer",
     "averaging_gbps_per_peer", "mfu",
 )
 
@@ -507,6 +520,7 @@ def main() -> None:
     averaging = _averaging_gbps()
     averaging_q8 = _averaging_gbps_q8()
     serving = _llama_serving()
+    swarm_sim = _swarm_sim()
     if result is None or result.get("tpu_unavailable"):
         # a tunnel wedged at round start may be free now (the averaging swarm just
         # bought several minutes): probe again mid-round
@@ -530,6 +544,19 @@ def main() -> None:
     q8_extra = (averaging_q8 or {}).get("extra") or {}
     result["extra"]["averaging_q8_success_rate"] = q8_extra.get("success_rate")
     result["extra"]["llama_serving_tok_s"] = (serving or {}).get("value")
+    # ISSUE 12: the swarm simulator's scale numbers — peers simulated,
+    # sim-seconds/wall-second, routing recall@beam, same-seed determinism
+    swarm_extra = (swarm_sim or {}).get("extra") or {}
+    result["extra"]["swarm_sim"] = {
+        "peers": (swarm_sim or {}).get("value"),
+        "sim_seconds_per_wall_second": swarm_extra.get("sim_seconds_per_wall_second"),
+        "recall_at_beam": swarm_extra.get("recall_at_beam"),
+        "deterministic": swarm_extra.get("deterministic"),
+        "get_success_rate": swarm_extra.get("get_success_rate"),
+        # the driver prints its JSON line before exiting nonzero on a breached
+        # invariant — without this list a failed soak would read as clean data
+        "failures": swarm_extra.get("failures"),
+    } if swarm_sim else None
     # the swarm telemetry + attribution snapshots land ONCE, in
     # result["telemetry"] below — strip them from the copied extra so the
     # artifact does not carry them twice

@@ -1,0 +1,196 @@
+#!/usr/bin/env python3
+"""Thousand-peer swarm simulator benchmark (ISSUE 12, ROADMAP item 5).
+
+Runs the in-process scenario harness (hivemind_tpu/sim) and prints ONE JSON
+line with the scale numbers the BENCH artifact records: peers simulated,
+sim-seconds per wall-second, beam-search routing recall@beam vs the
+brute-force oracle, and determinism (same seed twice → bit-identical scenario
+summaries).
+
+Modes:
+
+- ``--smoke``: tier-1-safe composite (~100 peers total: DHT store/get fan-out
+  under churn + link-scoped chaos, matchmaking convergence across a two-region
+  partition, beam search over a small grid) plus a same-seed-twice determinism
+  double-run of a reduced scenario. Exits nonzero on any failed invariant.
+- default (``--scenario soak``): the ROADMAP acceptance config — a 1000-peer
+  DHT + matchmaking scenario (seeded churn, bulk republish) run TWICE with the
+  same seed to prove bit-identical summaries, plus 10k-expert beam-search
+  routing quality with no partitions active (recall@beam must be ≥ 0.95).
+- ``--scenario <name>``: one scenario, parameters via flags.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hivemind_tpu.sim import run_scenario, scenario_names  # noqa: E402
+
+
+def _fail(message: str) -> None:
+    print(f"SWARM SIM FAILURE: {message}", file=sys.stderr, flush=True)
+    sys.exit(1)
+
+
+def _check(condition: bool, message: str, failures: list) -> None:
+    if not condition:
+        failures.append(message)
+        print(f"CHECK FAILED: {message}", file=sys.stderr, flush=True)
+
+
+def run_smoke(seed: int) -> dict:
+    failures: list = []
+    result = run_scenario("smoke", seed=seed)
+    s = result.summary
+    _check(s["chaos_link_rule_hits"] > 0, "link-scoped chaos rule never fired", failures)
+    _check(s["dht"]["get_success_rate"] >= 0.9, f"dht get success {s['dht']['get_success_rate']}", failures)
+    _check(s["dht"]["publish_messages"] > 0, "publish generated no traffic", failures)
+    _check(s["beam"]["recall_at_beam"] >= 0.95, f"beam recall {s['beam']['recall_at_beam']}", failures)
+    mm = s["matchmaking"]
+    _check(mm["groups_during"] > 0, "no groups formed during the partition", failures)
+    _check(mm["cross_region_during_settled"] == 0,
+           f"{mm['cross_region_during_settled']} cross-region groups formed across a severed link", failures)
+    _check(mm["convergence_during"] >= 0.75, f"partition convergence {mm['convergence_during']}", failures)
+
+    # determinism: a reduced scenario twice with one seed → identical digests
+    det_params = dict(peers=24, regions=2, keys=40, churn_fraction=0.15, probe_samples=20,
+                      matchmaking_peers=6, matchmaking_rounds=1)
+    first = run_scenario("dht_churn", seed=seed, **det_params)
+    second = run_scenario("dht_churn", seed=seed, **det_params)
+    deterministic = first.digest() == second.digest()
+    _check(deterministic, "same seed produced different summaries", failures)
+
+    peers_total = s["dht"]["peers"] + s["beam"]["peers"] + s["matchmaking"]["peers"]
+    sim_s = result.diagnostics["sim_seconds"] + first.diagnostics["sim_seconds"] + second.diagnostics["sim_seconds"]
+    wall_s = result.diagnostics["wall_seconds"] + first.diagnostics["wall_seconds"] + second.diagnostics["wall_seconds"]
+    out = {
+        "metric": "swarm_sim_peers",
+        "value": peers_total,
+        "unit": "peers",
+        "extra": {
+            "mode": "smoke",
+            "seed": seed,
+            "deterministic": deterministic,
+            "determinism_digest": first.digest()[:16],
+            "sim_seconds_per_wall_second": round(sim_s / max(wall_s, 1e-9), 2),
+            "recall_at_beam": s["beam"]["recall_at_beam"],
+            "get_success_rate": s["dht"]["get_success_rate"],
+            "matchmaking_convergence": mm["convergence_during"],
+            "chaos_link_rule_hits": s["chaos_link_rule_hits"],
+            "failures": failures,
+        },
+    }
+    print(json.dumps(out), flush=True)
+    if failures:
+        _fail("; ".join(failures))
+    return out
+
+
+def run_soak(seed: int, peers: int, experts_grid, beam_size: int, trials: int,
+             keys: int = None, churn_fraction: float = 0.10) -> dict:
+    """The acceptance config: 1000-peer DHT + matchmaking twice (bit-identical),
+    10k-expert beam routing (recall ≥ 0.95, no partitions)."""
+    failures: list = []
+    soak_params = dict(
+        peers=peers, regions=4, keys=keys if keys is not None else peers,
+        churn_fraction=churn_fraction, probe_samples=200,
+        matchmaking_peers=32, matchmaking_rounds=1,
+    )
+    first = run_scenario("dht_churn", seed=seed, **soak_params)
+    second = run_scenario("dht_churn", seed=seed, **soak_params)
+    deterministic = first.digest() == second.digest()
+    _check(deterministic, "1k soak: same seed produced different summaries", failures)
+    _check(first.summary["get_success_rate"] >= 0.9,
+           f"1k soak get success {first.summary['get_success_rate']}", failures)
+    _check(first.diagnostics["wall_seconds"] < 300,
+           f"1k soak took {first.diagnostics['wall_seconds']}s (budget 300s)", failures)
+
+    beam = run_scenario(
+        "beam_routing", seed=seed, peers=100, servers=50,
+        grid=tuple(experts_grid), beam_size=beam_size, trials=trials,
+    )
+    _check(beam.summary["recall_at_beam"] >= 0.95,
+           f"recall@beam {beam.summary['recall_at_beam']} < 0.95", failures)
+
+    mm = first.summary.get("matchmaking") or {}
+    out = {
+        "metric": "swarm_sim_peers",
+        "value": peers,
+        "unit": "peers",
+        "extra": {
+            "mode": "soak",
+            "seed": seed,
+            "deterministic": deterministic,
+            "determinism_digest": first.digest()[:16],
+            "soak_wall_seconds": first.diagnostics["wall_seconds"],
+            "sim_seconds_per_wall_second": first.diagnostics["sim_seconds_per_wall_second"],
+            "get_success_rate": first.summary["get_success_rate"],
+            "republish_messages": first.summary["republish_messages"],
+            "matchmaking_groups": mm.get("groups_formed"),
+            "experts": beam.summary["experts"],
+            "recall_at_beam": beam.summary["recall_at_beam"],
+            "beam_wall_seconds": beam.diagnostics["wall_seconds"],
+            "failures": failures,
+        },
+    }
+    print(json.dumps(out), flush=True)
+    if failures:
+        _fail("; ".join(failures))
+    return out
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--scenario", default="soak",
+                        choices=["soak", *scenario_names()])
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--smoke", action="store_true", help="tier-1-safe composite + determinism check")
+    parser.add_argument("--peers", type=int, default=None,
+                        help="peer count (scenario default if omitted; soak: 1000)")
+    parser.add_argument("--grid", type=int, nargs="+", default=[10, 10, 100])
+    parser.add_argument("--beam_size", type=int, default=8)
+    parser.add_argument("--trials", type=int, default=8)
+    parser.add_argument("--keys", type=int, default=None)
+    parser.add_argument("--churn_fraction", type=float, default=0.1)
+    args = parser.parse_args()
+
+    if args.smoke or args.scenario == "smoke":
+        # the composite's invariants must always be checked (nonzero exit on
+        # any failure) — the generic path below would skip them
+        run_smoke(args.seed)
+        return
+    if args.scenario == "soak":
+        run_soak(args.seed, args.peers if args.peers is not None else 1000,
+                 args.grid, args.beam_size, args.trials,
+                 keys=args.keys, churn_fraction=args.churn_fraction)
+        return
+
+    # single-scenario paths: honor every supplied flag, fall back to the
+    # scenario's own defaults when a flag is omitted
+    params = {}
+    if args.scenario == "dht_churn":
+        peers = args.peers if args.peers is not None else 1000
+        params = dict(peers=peers, keys=args.keys if args.keys is not None else peers,
+                      churn_fraction=args.churn_fraction)
+    elif args.scenario == "beam_routing":
+        params = dict(grid=tuple(args.grid), beam_size=args.beam_size, trials=args.trials)
+        if args.peers is not None:
+            params["peers"] = args.peers
+    elif args.scenario == "matchmaking_partition":
+        if args.peers is not None:
+            params["peers"] = args.peers
+    result = run_scenario(args.scenario, seed=args.seed, **params)
+    print(json.dumps({
+        "metric": f"swarm_sim_{args.scenario}",
+        "value": result.summary.get("peers"),
+        "unit": "peers",
+        "extra": {"summary": result.summary, "diagnostics": result.diagnostics,
+                  "digest": result.digest()[:16]},
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

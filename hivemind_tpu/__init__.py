@@ -51,6 +51,10 @@ def __getattr__(name):  # lazy top-level API so `import hivemind_tpu` stays ligh
         "Deadline": "hivemind_tpu.resilience",
         "BreakerBoard": "hivemind_tpu.resilience",
         "CHAOS": "hivemind_tpu.resilience",
+        "SimNetwork": "hivemind_tpu.sim",
+        "SimPeer": "hivemind_tpu.sim",
+        "LinkMatrix": "hivemind_tpu.sim",
+        "run_scenario": "hivemind_tpu.sim",
     }
     if name in top_level:
         module = importlib.import_module(top_level[name])

@@ -61,6 +61,11 @@ from hivemind_tpu.telemetry.tracing import (
 _DHT_OP_LATENCY = _TELEMETRY.histogram(
     "hivemind_dht_operation_latency_seconds", "store_many/get_many wall time", ("op",)
 )
+_DHT_STORE_TRAVERSALS_SAVED = _TELEMETRY.counter(
+    "hivemind_dht_store_traversals_saved_total",
+    "store_many keys that reused another key's beam search because their local "
+    "nearest-neighbor sets coincided (bulk republish path)",
+)
 
 
 class Blacklist(BreakerBoard):
@@ -305,6 +310,75 @@ class DHTNode:
             }
         return output
 
+    # bulk stores below this size keep the classic one-beam-per-key path;
+    # grouping only pays when many keys share a neighborhood (ISSUE 12)
+    _STORE_GROUPING_MIN_KEYS = 16
+
+    async def _find_nearest_grouped(
+        self, key_ids: List[DHTID], k_nearest: int, exclude_self: bool
+    ) -> Dict[DHTID, Dict[DHTID, PeerInfo]]:
+        """``find_nearest_nodes`` for bulk stores: keys whose local nearest-neighbor
+        sets coincide share ONE beam search (run for a representative key with a
+        widened beam), and each member re-ranks the shared contact pool by its own
+        xor distance. At 10k expert declarations over a 1k-peer swarm the per-key
+        traversal is the dominant republish cost; most keys land in one of ~N
+        distinct neighborhoods, so this collapses the traversal count from
+        O(keys) to O(distinct neighborhoods)."""
+        if len(key_ids) < self._STORE_GROUPING_MIN_KEYS:
+            return await self.find_nearest_nodes(key_ids, k_nearest=k_nearest, exclude_self=exclude_self)
+        # replica placement must stay (near-)exact: divergent replica sets shard
+        # subkey dictionaries across extra nodes, and readers that stop at the
+        # first fresh value then see PARTIAL dicts (measured: beam-search recall
+        # 0.71 vs 1.0 with a naive top-k signature). Three safeguards: keys
+        # group only when their local neighborhoods coincide at DOUBLE the
+        # replica count, the shared traversal returns that doubled pool for
+        # per-member re-ranking, and any member whose OWN routing table knows a
+        # node nearer than its chosen k-th replica that the pool lacks (a
+        # witness that the pool is inadequate for this key) falls back to an
+        # exact traversal.
+        pool_size = max(2 * k_nearest, k_nearest + 4)
+        groups: Dict[frozenset, List[DHTID]] = {}
+        local_nearest: Dict[DHTID, List[DHTID]] = {}
+        for key_id in key_ids:
+            ordered = [
+                node_id
+                for node_id, _info in self.protocol.routing_table.get_nearest_neighbors(key_id, pool_size)
+            ]
+            # kept for the witness check below: its k_nearest-prefix is exactly
+            # this scan's head, so each key pays ONE table scan, not two
+            local_nearest[key_id] = ordered[:k_nearest]
+            groups.setdefault(frozenset(ordered), []).append(key_id)
+        if len(groups) > 0.75 * len(key_ids):
+            # neighborhoods barely overlap: grouping buys nothing, keep exact placement
+            return await self.find_nearest_nodes(key_ids, k_nearest=k_nearest, exclude_self=exclude_self)
+        representatives = [members[0] for members in groups.values()]
+        rep_nearest = await self.find_nearest_nodes(
+            representatives, k_nearest=pool_size, exclude_self=exclude_self
+        )
+        output: Dict[DHTID, Dict[DHTID, PeerInfo]] = {}
+        fallback: List[DHTID] = []
+        for members in groups.values():
+            pool = rep_nearest[members[0]]
+            for key_id in members:
+                ranked = sorted(pool, key=key_id.xor_distance)[:k_nearest]
+                worst = key_id.xor_distance(ranked[-1]) if ranked else None
+                inadequate = worst is None or any(
+                    node_id not in pool and key_id.xor_distance(node_id) < worst
+                    for node_id in local_nearest[key_id]
+                )
+                if inadequate and key_id != members[0]:
+                    fallback.append(key_id)
+                else:
+                    output[key_id] = {node_id: pool[node_id] for node_id in ranked}
+        if fallback:
+            output.update(
+                await self.find_nearest_nodes(fallback, k_nearest=k_nearest, exclude_self=exclude_self)
+            )
+        saved = len(key_ids) - len(representatives) - len(fallback)
+        if saved > 0:
+            _DHT_STORE_TRAVERSALS_SAVED.inc(saved)
+        return output
+
     # ------------------------------------------------------------------ store
 
     async def store(
@@ -351,7 +425,7 @@ class DHTNode:
             result_key = (key, subkey) if subkey is not None else key
             prepared[key_id].append((subkey, binary_value, expiration, result_key))
 
-        nearest = await self.find_nearest_nodes(
+        nearest = await self._find_nearest_grouped(
             list(prepared.keys()), k_nearest=self.num_replicas, exclude_self=exclude_self or self.client_mode
         )
 

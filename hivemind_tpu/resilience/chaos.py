@@ -47,6 +47,17 @@ or ``<point>:<action>[:key=value]...`` with keys ``prob`` (default 1.0),
 default 125e6 ≈ 1 Gbps), ``after`` (skip the first N matching calls),
 ``times`` (max injections), ``scope`` (substring matched against the call
 site's scope). A point may end in ``*`` for prefix matching (``p2p.*``).
+
+Directional link scoping (ISSUE 12): a rule whose scope starts with ``link:``
+matches only call sites that identify a directed link, ``scope=link:<src>-><dst>``
+— the in-process swarm simulator (hivemind_tpu/sim) tags every simulated RPC
+this way. Each side is a peer id pattern with ``*`` wildcards
+(``fnmatch``-style), so ``link:*->QmBob*`` throttles everything flowing INTO
+one peer while ``link:QmAli*->QmBob*`` faults exactly one direction of one
+link. Non-link rules keep substring semantics; because a link scope string
+contains both endpoint ids, a plain ``scope=<peer_b58>`` rule matches both
+directions of that peer's simulated links — the existing 14-point catalog
+composes with per-link schedules unchanged.
 """
 
 from __future__ import annotations
@@ -55,6 +66,7 @@ import asyncio
 import os
 import random
 import zlib
+from fnmatch import fnmatchcase
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -112,7 +124,11 @@ class ChaosRule:
         elif point != self.point:
             return False
         if self.scope is not None:
-            if scope is None or self.scope not in scope:
+            if scope is None:
+                return False
+            if self.scope.startswith("link:"):
+                return _match_link_scope(self.scope, scope)
+            if self.scope not in scope:
                 return False
         return True
 
@@ -129,6 +145,19 @@ class ChaosRule:
             return False
         self.hits += 1
         return True
+
+
+def _match_link_scope(rule_scope: str, call_scope: str) -> bool:
+    """``link:<src_pat>-><dst_pat>`` vs a call site's ``link:<src>-><dst>``.
+    Patterns use ``*`` wildcards per side; a call site that carries no link
+    identity never matches a link-scoped rule."""
+    if not call_scope.startswith("link:"):
+        return False
+    src_pat, arrow, dst_pat = rule_scope[len("link:"):].partition("->")
+    src, call_arrow, dst = call_scope[len("link:"):].partition("->")
+    if not arrow or not call_arrow:
+        return False
+    return fnmatchcase(src, src_pat) and fnmatchcase(dst, dst_pat)
 
 
 def _rule_seed(seed: int, index: int, point: str, action: str) -> int:
@@ -184,12 +213,20 @@ class ChaosEngine:
         for segment in segments:
             if segment.startswith("seed="):
                 continue
-            fields = segment.split(":")
-            if len(fields) < 2:
+            raw = segment.split(":")
+            if len(raw) < 2:
                 raise ValueError(f"bad chaos segment {segment!r}: need <point>:<action>")
-            point, action = fields[0], fields[1]
+            point, action = raw[0], raw[1]
+            # a value may itself contain ":" (scope=link:<src>-><dst>): a part
+            # with no "=" re-joins the key=value field it was split off from
+            fields: List[str] = []
+            for part in raw[2:]:
+                if "=" in part or not fields:
+                    fields.append(part)
+                else:
+                    fields[-1] = f"{fields[-1]}:{part}"
             kwargs: Dict[str, object] = {}
-            for kv in fields[2:]:
+            for kv in fields:
                 key, _, value = kv.partition("=")
                 if key in ("prob", "delay", "rate"):
                     kwargs[key] = float(value)
@@ -206,6 +243,11 @@ class ChaosEngine:
         if spec:
             self.configure(spec)
             logger.warning(f"HIVEMIND_CHAOS armed: {len(self._rules)} fault rule(s) active")
+
+    def remove_rule(self, rule: ChaosRule) -> None:
+        """Retire one rule (e.g. a scenario-scoped fault) leaving the rest armed."""
+        self._rules.remove(rule)
+        self.enabled = bool(self._rules)
 
     def clear(self) -> None:
         self._rules = []

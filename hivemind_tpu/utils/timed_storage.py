@@ -16,10 +16,26 @@ ValueType = TypeVar("ValueType")
 DHTExpiration = float
 MAX_DHT_TIME_DISCREPANCY_SECONDS = 3.0  # max tolerated clock skew between peers
 
+# swappable swarm-time source: None = wall clock. The swarm simulator
+# (hivemind_tpu/sim) installs its virtual clock here so every expiration,
+# declaration window and blacklist backoff in the process tracks simulated
+# time; production never touches it. A module-global (not monkeypatching
+# get_dht_time itself) because callers across the tree bound the function
+# object at import time.
+_dht_time_source = None
+
+
+def set_dht_time_source(source) -> None:
+    """Install a ``() -> float`` swarm-time source, or None to restore wall time."""
+    global _dht_time_source
+    _dht_time_source = source
+
 
 def get_dht_time() -> DHTExpiration:
     """Global swarm time. Approximated as local UNIX time; peers tolerate up to
     MAX_DHT_TIME_DISCREPANCY_SECONDS of skew (reference timed_storage.py:13-14)."""
+    if _dht_time_source is not None:
+        return _dht_time_source()
     return time.time()
 
 

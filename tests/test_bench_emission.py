@@ -262,6 +262,55 @@ def test_benchmark_llama_serving_smoke():
     assert result["extra"]["activation_compression"] == "float16"
 
 
+def test_benchmark_swarm_sim_smoke():
+    """ISSUE 12: the swarm simulator end-to-end in --smoke mode — a ~100-peer
+    composite (DHT fan-out under churn + link-scoped chaos, matchmaking
+    convergence across a partition, beam search vs oracle) plus a
+    same-seed-twice determinism double-run; any failed invariant exits nonzero,
+    so a sim/transport regression fails tier-1 loudly (mirrors the averaging
+    and serving smoke patterns)."""
+    import subprocess
+    import sys
+
+    script = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks", "benchmark_swarm_sim.py",
+    )
+    run = subprocess.run(
+        [sys.executable, script, "--smoke", "--seed", "17"],
+        timeout=420,
+        capture_output=True,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert run.returncode == 0, f"smoke benchmark failed:\n{run.stdout[-2000:]}\n{run.stderr[-2000:]}"
+    payload = next(line for line in run.stdout.splitlines() if line.startswith("{"))
+    result = json.loads(payload)
+    assert result["metric"] == "swarm_sim_peers"
+    assert result["value"] >= 90  # ~100 peers simulated across the composite
+    assert result["extra"]["deterministic"] is True
+    assert result["extra"]["recall_at_beam"] >= 0.95
+    assert result["extra"]["failures"] == []
+
+
+def test_bench_artifact_compact_line_carries_swarm_sim():
+    """The swarm-sim scale numbers ride the compact driver line (and drop
+    early under pressure, before the headline metrics)."""
+    result = _bloated_result()
+    result["extra"]["swarm_sim"] = {
+        "peers": 300, "sim_seconds_per_wall_second": 0.62,
+        "recall_at_beam": 1.0, "deterministic": True, "get_success_rate": 1.0,
+    }
+    parsed = json.loads(bench.compact_result(result))
+    assert parsed["extra"]["swarm_sim"]["peers"] == 300
+    assert parsed["extra"]["swarm_sim"]["deterministic"] is True
+    # under pathological pressure the line still fits and leads with the metric
+    result["extra"]["device"] = "d" * 3000
+    line = bench.compact_result(result)
+    assert len(line) <= 1500
+    assert json.loads(line)["metric"] == "albert_base_mlm_tokens_per_sec_per_chip"
+
+
 def test_bench_artifact_embeds_serving_attribution():
     """ISSUE 9: the llama-serving swarm's per-request attribution summary rides
     the BENCH artifact under telemetry.serving — per-expert p50/p95, phase

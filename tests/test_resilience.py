@@ -258,6 +258,52 @@ async def test_chaos_after_times_scope_and_corrupt():
     assert await engine.inject("p2p.unary.send", payload={"not": "bytes"}) == {"not": "bytes"}
 
 
+async def test_chaos_link_scope_directional_matching():
+    """ISSUE 12: ``scope=link:<src>-><dst>`` rules fault exactly one direction
+    of one link (wildcard ends supported); non-link call sites never match a
+    link rule, and plain peer-substring rules still match link scopes because
+    the link string carries both endpoint ids."""
+    engine = ChaosEngine()
+    engine.reseed(5)
+    engine.add_rule("p2p.unary.send", "abort", scope="link:alice->bob")
+    # matching direction fires
+    with pytest.raises(ChaosAbort):
+        await engine.inject("p2p.unary.send", scope="link:alice->bob")
+    # reverse direction and other links do not
+    await engine.inject("p2p.unary.send", scope="link:bob->alice")
+    await engine.inject("p2p.unary.send", scope="link:alice->carol")
+    # a non-link call site (plain peer scope) never matches a link rule
+    await engine.inject("p2p.unary.send", scope="alice")
+    assert engine.stats() == {"p2p.unary.send:abort": 1}
+
+    engine.clear()
+    engine.add_rule("p2p.unary.send", "abort", scope="link:*->bob*")
+    with pytest.raises(ChaosAbort):
+        await engine.inject("p2p.unary.send", scope="link:anyone->bob2")
+    await engine.inject("p2p.unary.send", scope="link:bob2->anyone")  # into bob only
+    assert engine.stats() == {"p2p.unary.send:abort": 1}
+
+    # legacy substring rule composes: it hits both directions of the peer's links
+    engine.clear()
+    engine.add_rule("p2p.unary.send", "abort", scope="bob")
+    with pytest.raises(ChaosAbort):
+        await engine.inject("p2p.unary.send", scope="link:alice->bob")
+    with pytest.raises(ChaosAbort):
+        await engine.inject("p2p.unary.send", scope="link:bob->alice")
+
+
+async def test_chaos_link_scope_grammar_survives_colons():
+    """The HIVEMIND_CHAOS grammar splits on ':' — a link scope's own colon must
+    re-join its key=value field instead of becoming an unknown key."""
+    engine = ChaosEngine()
+    engine.configure("seed=3;p2p.unary.send:drop:times=2:scope=link:src*->dst*")
+    (rule,) = engine.rules
+    assert rule.scope == "link:src*->dst*" and rule.times == 2
+    with pytest.raises(ChaosDrop):
+        await engine.inject("p2p.unary.send", scope="link:src1->dst9")
+    await engine.inject("p2p.unary.send", scope="link:dst9->src1")  # wrong direction
+
+
 async def test_chaos_throttle_is_byte_proportional():
     """ISSUE 11: the `throttle` action models a bandwidth-limited link — sleep
     time scales with the payload's wire size; payload-free points are no-ops."""
