@@ -577,6 +577,210 @@ def run_soak(
             checkpoint_dir_ctx.cleanup()
 
 
+def run_serving_churn(
+    duration: float = 45.0,
+    seed: int = 0,
+    n_experts: int = 2,
+    stall_fraction: float = 0.25,
+    kill_fraction: float = 0.45,
+    restart_fraction: float = 0.7,
+) -> dict:
+    """Serving-churn soak (ISSUE 13): two servers replicate the same expert
+    grid; mid-traffic one replica is first STALLED (its runtime suspended — the
+    straggler that makes hedges fire) and then crash-killed (its DHT yanked, no
+    shutdown), later restarted under a fresh identity. The verdict requires:
+
+    - ``hedges_fired >= 1`` — the stall was hedged around, not waited out,
+    - ``client_failures == 0`` — replica death is never client-visible
+      (failover + hedging absorb it),
+    - ``breakers_recovered`` — after the restart, no breaker is left open
+      except against the dead identity (which never comes back),
+    - ``post_restart_ok > 0`` and the resolved replica set includes the
+      restarted server.
+    """
+    import numpy as np
+    import optax
+
+    from hivemind_tpu.dht import DHT
+    from hivemind_tpu.moe import RemoteExpert, Server, get_experts
+    from hivemind_tpu.moe.client.call_many import EXPERT_BREAKERS
+    from hivemind_tpu.telemetry.serving import SCORECARDS
+
+    report: Dict[str, object] = {"duration": duration, "seed": seed, "mode": "serving_churn"}
+    reset_all_boards()
+    SCORECARDS.clear()
+    original_recovery = EXPERT_BREAKERS._kwargs["recovery_time"]
+    EXPERT_BREAKERS.reconfigure(recovery_time=3.0)
+    uids = [f"srv_churn.{i}" for i in range(n_experts)]
+
+    def hedge_counts() -> Dict[str, float]:
+        metric = REGISTRY.get("hivemind_moe_hedge_total")
+        if metric is None:
+            return {}
+        return {",".join(key): child.value for key, child in metric.series()}
+
+    def failover_total() -> float:
+        metric = REGISTRY.get("hivemind_moe_replica_failover_total")
+        return sum(child.value for _k, child in metric.series()) if metric is not None else 0.0
+
+    hedges_before = hedge_counts()
+    failovers_before = failover_total()
+
+    dht_a = DHT(start=True)
+    maddrs = [str(m) for m in dht_a.get_visible_maddrs()]
+    server_a = Server.create(
+        expert_uids=uids, expert_cls="ffn", hidden_dim=16, dht=dht_a, start=True,
+        max_batch_size=64, optim_factory=lambda: optax.sgd(1e-3),
+    )
+    dht_b = DHT(initial_peers=maddrs, start=True)
+    server_b = Server.create(
+        expert_uids=uids, expert_cls="ffn", hidden_dim=16, dht=dht_b, start=True,
+        max_batch_size=64, optim_factory=lambda: optax.sgd(1e-3),
+    )
+    client_dht = DHT(initial_peers=maddrs, start=True)
+    dead_peer_ids: List[str] = []
+
+    stop_event = threading.Event()
+    stats = {"ok": 0, "failures": 0, "post_restart_ok": 0}
+    phase = {"name": "warm"}
+    errors: List[str] = []
+
+    def run_traffic() -> None:
+        import numpy as _np
+
+        try:
+            infos = None
+            for _attempt in range(30):
+                infos = get_experts(client_dht, uids)
+                if all(i is not None and len(i.replica_set) == 2 for i in infos):
+                    break
+                time.sleep(0.5)
+            if infos is None or any(i is None for i in infos):
+                errors.append("serving churn: experts never resolved")
+                return
+            experts = [RemoteExpert(info, client_dht.node.p2p) for info in infos]
+            x = _np.random.RandomState(seed).randn(2, 16).astype(_np.float32)
+            while not stop_event.is_set():
+                for expert in experts:
+                    try:
+                        expert.forward_np(x)
+                        stats["ok"] += 1
+                        if phase["name"] == "restarted":
+                            stats["post_restart_ok"] += 1
+                    except Exception as e:
+                        stats["failures"] += 1
+                        errors.append(f"client-visible failure in {phase['name']}: {e!r}")
+                time.sleep(0.05)
+        except Exception as e:
+            errors.append(f"traffic thread: {e!r}")
+
+    traffic = threading.Thread(target=run_traffic)
+    traffic.start()
+    restarted_server = restarted_dht = None
+    # placeholders until the victim is chosen at stall time (an early failure
+    # cleans up one pair and leaves the other dangling, like the crash it is)
+    survivor_server, survivor_dht = server_a, dht_a
+    try:
+        time.sleep(duration * stall_fraction)
+        # the client's routing turns deterministic once scorecards warm
+        # (measured replicas sort by mean latency), so by now traffic has
+        # concentrated on ONE replica — the victim must be THAT replica, or
+        # the stall lands on a server nobody dials and no hedge can fire
+        def replica_requests(peer_b58: str) -> int:
+            total = 0
+            for uid in uids:
+                card = SCORECARDS.card(uid) or {}
+                entry = (card.get("replicas") or {}).get(peer_b58)
+                if entry:
+                    total += int(entry.get("requests", 0))
+            return total
+
+        victim_is_b = replica_requests(str(dht_b.peer_id)) >= replica_requests(str(dht_a.peer_id))
+        victim_server, victim_dht = (server_b, dht_b) if victim_is_b else (server_a, dht_a)
+        survivor_server, survivor_dht = (server_a, dht_a) if victim_is_b else (server_b, dht_b)
+        victim_name = "B" if victim_is_b else "A"
+
+        # phase 1: the victim becomes a straggler — its runtime stops draining,
+        # so in-flight requests hang past p95 and the client must hedge
+        phase["name"] = "stalled"
+        logger.warning(f"serving churn: stalling replica {victim_name}'s runtime (hedge bait)")
+
+        async def _stall():
+            victim_server.runtime._task.cancel()
+
+        victim_server._runner.run_coroutine(_stall(), return_future=True).result(5)
+        time.sleep(duration * (kill_fraction - stall_fraction))
+
+        # phase 2: crash-kill the victim (transport yanked, no clean shutdown —
+        # its declarations dangle in the DHT like a real dead process's)
+        phase["name"] = "killed"
+        logger.warning(f"serving churn: crash-killing replica {victim_name}")
+        dead_peer_ids.append(str(victim_dht.peer_id))
+        victim_dht.shutdown()
+        time.sleep(duration * (restart_fraction - kill_fraction))
+
+        # phase 3: restart under a fresh identity; it re-declares the same uids
+        phase["name"] = "restarting"
+        logger.warning(f"serving churn: restarting replica {victim_name}")
+        restarted_dht = DHT(initial_peers=maddrs, start=True)
+        restarted_server = Server.create(
+            expert_uids=uids, expert_cls="ffn", hidden_dim=16, dht=restarted_dht,
+            start=True, max_batch_size=64, optim_factory=lambda: optax.sgd(1e-3),
+        )
+        time.sleep(2.0)
+        phase["name"] = "restarted"
+        time.sleep(max(duration * (1.0 - restart_fraction) - 2.0, 5.0))
+
+        infos = get_experts(client_dht, uids)
+        live_peers = {
+            replica.peer_id.to_base58()
+            for info in infos if info is not None
+            for replica in info.replica_set
+        }
+        report["resolved_replicas"] = sorted(live_peers)
+        restarted_visible = str(restarted_dht.peer_id) in live_peers
+    finally:
+        stop_event.set()
+        traffic.join(timeout=30)
+
+        hedges_after = hedge_counts()
+        hedges_fired = hedges_after.get("fired", 0) - hedges_before.get("fired", 0)
+        tripped = [
+            str(key) for key in EXPERT_BREAKERS.tripped_keys()
+            if not any(dead in str(key) for dead in dead_peer_ids)
+        ]
+
+        for component in (survivor_server, restarted_server):
+            if component is not None:
+                component.shutdown()
+        for component in (survivor_dht, restarted_dht, client_dht):
+            if component is not None:
+                component.shutdown()
+        EXPERT_BREAKERS.reconfigure(recovery_time=original_recovery)
+        reset_all_boards()
+
+    report.update(
+        traffic=dict(stats),
+        hedges_fired=hedges_fired,
+        hedge_outcomes={k: hedges_after.get(k, 0) - hedges_before.get(k, 0) for k in hedges_after},
+        replica_failovers=failover_total() - failovers_before,
+        breakers_still_tripped=tripped,
+        dead_peer_ids=dead_peer_ids,
+        errors=errors,
+    )
+    checks = {
+        "traffic_flowed": stats["ok"] > 0,
+        "hedge_fired": hedges_fired >= 1,
+        "zero_client_visible_failures": stats["failures"] == 0,
+        "post_restart_ok": stats["post_restart_ok"] > 0,
+        "restarted_replica_visible": bool(restarted_visible),
+        "breakers_recovered": not tripped,
+    }
+    report["checks"] = checks
+    report["ok"] = all(checks.values())
+    return report
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
     parser.add_argument("--peers", type=int, default=4)
@@ -594,7 +798,17 @@ def main() -> None:
                         help="directory for per-peer crash-safe checkpoints (default: a tempdir)")
     parser.add_argument("--spec", default=None,
                         help="HIVEMIND_CHAOS-grammar schedule overriding the default")
+    parser.add_argument("--serving", action="store_true",
+                        help="serving-churn phase (ISSUE 13): two replicas of one "
+                             "expert grid, one stalled then crash-killed then "
+                             "restarted mid-traffic; verdict requires >=1 hedge "
+                             "fired, zero client-visible failures, breakers "
+                             "recovered after the restart")
     args = parser.parse_args()
+    if args.serving:
+        report = run_serving_churn(duration=args.duration, seed=args.seed)
+        print(json.dumps(report, indent=2, default=str))
+        sys.exit(0 if report["ok"] else 1)
     report = run_soak(
         n_peers=args.peers, duration=args.duration, seed=args.seed,
         chaos_fraction=args.chaos_fraction, include_moe=not args.no_moe, spec=args.spec,

@@ -42,6 +42,25 @@ def main():
                              "'none' = bit-identical fp32). Published in expert "
                              "info + DHT declarations so clients negotiate the "
                              "same codec for requests; see docs/benchmarks.md")
+    parser.add_argument("--client_rate", type=float, default=None,
+                        help="fair-share admission (ISSUE 13): per-client token "
+                             "budget in samples/s — a hot client past its bucket "
+                             "is shed (typed ClientOverBudgetError, counted in "
+                             "hivemind_moe_admission_shed_total) while other "
+                             "clients keep flowing. Default: off")
+    parser.add_argument("--client_burst", type=float, default=None,
+                        help="token-bucket burst ceiling (default 2s of --client_rate)")
+    parser.add_argument("--replica_slots", type=int, default=0,
+                        help="acquire up to this many hot experts from other "
+                             "servers (rpc_replica_state transfer, then served + "
+                             "declared here as extra replicas)")
+    parser.add_argument("--replicate_hot_experts", action="store_true",
+                        help="advertise this server's hot experts (ServingLedger "
+                             "QPS/occupancy thresholds) under replica_wanted.* so "
+                             "servers with --replica_slots pick them up")
+    parser.add_argument("--replication_watch_grids", nargs="*", default=None,
+                        help="grid roots to scan for replica_wanted adverts "
+                             "(default: the roots of this server's own experts)")
     parser.add_argument("--custom_module_path", default=None,
                         help="path to a .py file whose @register_expert_class "
                              "decorators run before the server starts (capability "
@@ -141,6 +160,11 @@ def main():
         decode_max_sessions=args.decode_max_sessions,
         max_queue_size=args.max_queue_size,
         activation_compression=args.activation_compression,
+        client_rate=args.client_rate,
+        client_burst=args.client_burst,
+        replica_slots=args.replica_slots,
+        replicate_hot_experts=args.replicate_hot_experts,
+        replication_watch_grids=args.replication_watch_grids,
         optim_factory=lambda: optax.adam(args.learning_rate),
         start=True,
     )
@@ -230,6 +254,8 @@ def _serve_llama_checkpoint(args) -> Server:
         decode_max_sessions=args.decode_sessions_budget,
         max_queue_size=args.max_queue_size,
         activation_compression=args.activation_compression,
+        client_rate=args.client_rate,
+        client_burst=args.client_burst,
     )
     server.run_in_background(await_ready=True)
     return server

@@ -105,15 +105,14 @@ class MoEBeamSearcher:
         for beam in beams:
             for _neg, uid in beam:
                 leaf_uids[uid] = None
-        uid_to_peer = await self._resolve_leaves(node, list(leaf_uids.keys()))
+        uid_to_info = await self._resolve_leaves(node, list(leaf_uids.keys()))
         results: List[List[ExpertInfo]] = []
         for beam in beams:
             sample_result = []
             for neg_score, uid in sorted(beam):
-                resolved = uid_to_peer.get(uid)
+                resolved = uid_to_info.get(uid)
                 if resolved is not None:
-                    peer_id, compression = resolved
-                    sample_result.append(ExpertInfo(uid, peer_id, compression))
+                    sample_result.append(resolved)
             results.append(sample_result)
         return results
 
@@ -137,19 +136,28 @@ class MoEBeamSearcher:
         return out
 
     async def _resolve_leaves(self, node, uids: List[str]):
-        """uid -> (peer_id, advertised activation compression or None); the
-        record may be a bare peer id or ``peer|compression`` (dht_handler)."""
-        from hivemind_tpu.moe.server.dht_handler import parse_expert_record
+        """uid -> resolved :class:`ExpertInfo` carrying the FULL replica set
+        (ISSUE 13); the record may be a bare peer id, ``peer|compression``, or
+        a subkey dictionary of replica records (dht_handler)."""
+        from hivemind_tpu.moe.server.dht_handler import expert_info_from_entry
 
         if not uids:
             return {}
+        # deliberately FIRST-FRESH (not the merging REPLICA_SET_SUFFICIENCY
+        # traversal get_experts uses): beam-search leaf resolution runs on the
+        # per-forward hot path of RemoteMixtureOfExperts, and an unreachable
+        # sufficiency would force full network traversals per leaf per batch.
+        # The cost of a partial subkey dict here is a temporarily thinner
+        # replica set for this call — balancing is less informed, while
+        # failover/breakers/alive-mask still handle a stale dead entry exactly
+        # as they did for single-value records.
         found = await node.get_many(uids)
         out = {}
         for uid in uids:
             entry = found.get(uid)
-            parsed = parse_expert_record(entry.value) if entry is not None else None
-            if parsed is not None:
-                out[uid] = parsed
+            info = expert_info_from_entry(uid, entry.value) if entry is not None else None
+            if info is not None:
+                out[uid] = info
         return out
 
     def get_initial_beam(self, dim_scores: np.ndarray, beam_size: int):

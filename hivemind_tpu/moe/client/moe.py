@@ -67,9 +67,12 @@ class RemoteMixtureOfExperts:
         self._experts: Dict[str, RemoteExpert] = {}
 
     def _get_expert(self, info: ExpertInfo) -> RemoteExpert:
-        if info.uid not in self._experts:
-            self._experts[info.uid] = RemoteExpert(info, self.p2p)
-        return self._experts[info.uid]
+        expert = self._experts.get(info.uid)
+        if expert is None:
+            expert = self._experts[info.uid] = RemoteExpert(info, self.p2p)
+        elif expert.expert_info != info:
+            expert.update_info(info)  # replica set / primary may have moved
+        return expert
 
     def expert_scorecards(self) -> Dict[str, dict]:
         """This client's serving scorecards (ISSUE 9) for the experts this

@@ -14,8 +14,10 @@ through the WHOLE pipeline, and every backward RPC also trains the server-side b
 
 from __future__ import annotations
 
+import random
 import threading
 import time
+import zlib
 from typing import Dict, Optional
 
 import jax
@@ -24,6 +26,7 @@ from hivemind_tpu.dht import DHT
 from hivemind_tpu.moe.client.expert import RemoteExpert
 from hivemind_tpu.moe.expert_uid import ExpertInfo
 from hivemind_tpu.moe.server.dht_handler import get_experts
+from hivemind_tpu.p2p import PeerID
 from hivemind_tpu.resilience import RetryPolicy
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.loop import get_loop_runner
@@ -109,6 +112,10 @@ class RemoteSequential:
         # "positions": retained position count}
         self._decode_routes: Dict[str, dict] = {}
         self.max_decode_routes = 256  # oldest pinned routes drop beyond this
+        # seeded replica choice across route resolutions (ISSUE 13): fresh
+        # clients spread over the replica set instead of all pinning the first
+        # declared server, yet each client's choices replay deterministically
+        self._route_rng = random.Random(zlib.crc32(f"{prefix}|{self.p2p.peer_id}".encode()))
         self._lock = threading.Lock()
 
     @property
@@ -183,14 +190,54 @@ class RemoteSequential:
                 self._span_support[head.peer_id] = supported
         return supported
 
+    def _select_block_replica(
+        self, info: ExpertInfo, preferred: Optional[PeerID]
+    ) -> ExpertInfo:
+        """Pick this block's serving replica (ISSUE 13): breaker-open replicas
+        are avoided (a killed server must drop out of fresh routes instantly),
+        the PREVIOUS block's peer is kept when it also hosts this block (span
+        grouping — one RPC per server, not per block), then the shared
+        replica-health policy (expert.classify_replicas) decides, with a
+        seeded-random pick while cold."""
+        replicas = info.replica_set
+        if len(replicas) == 1:
+            return info
+        from hivemind_tpu.moe.client.call_many import EXPERT_BREAKERS
+        from hivemind_tpu.moe.client.expert import classify_replicas
+
+        measured, cold, failing, banned = classify_replicas(
+            info.uid, replicas, EXPERT_BREAKERS
+        )
+        live = [replica for _rate, _mean, replica in measured] + cold + failing
+        pool = live if live else list(replicas)
+        chosen = None
+        if preferred is not None:
+            for replica in pool:
+                if replica.peer_id == preferred:
+                    chosen = replica
+                    break
+        if chosen is None:
+            if measured:
+                chosen = measured[0][2]
+            else:
+                chosen = self._route_rng.choice(cold or pool)
+        return ExpertInfo(info.uid, chosen.peer_id, chosen.compression, info.replicas)
+
     def _grouped_range(self, start: int, stop: int, force: bool = False):
         """Resolve blocks [start, stop) and group CONSECUTIVE same-peer blocks into
-        spans: each group is one RPC (server chains the blocks — span execution)."""
-        blocks = [
-            RemoteExpert(self._resolve_info(index, force=force), self.p2p,
-                         request_compression=self.request_compression)
-            for index in range(start, stop)
-        ]
+        spans: each group is one RPC (server chains the blocks — span execution).
+        Replicated blocks prefer staying on the previous block's peer so spans
+        survive replication (see _select_block_replica)."""
+        blocks = []
+        preferred: Optional[PeerID] = None
+        for index in range(start, stop):
+            chosen = self._select_block_replica(
+                self._resolve_info(index, force=force), preferred
+            )
+            blocks.append(
+                RemoteExpert(chosen, self.p2p, request_compression=self.request_compression)
+            )
+            preferred = chosen.peer_id
         groups = []
         for block in blocks:
             if (
@@ -369,6 +416,7 @@ class RemoteSequential:
             # long generation costs O(1) per step, not an O(context) recopy), capped by
             # max_failover_history — past the cap, retention stops and a dead peer is
             # a hard error again (restart with reset=True), bounding client memory
+            step_appended = False
             if reset:
                 if self.max_failover_history and x.shape[1] <= self.max_failover_history:
                     state["chunks"], state["positions"] = [x], x.shape[1]
@@ -378,13 +426,34 @@ class RemoteSequential:
                 if state["positions"] + x.shape[1] <= self.max_failover_history:
                     state["chunks"].append(x)
                     state["positions"] += x.shape[1]
+                    step_appended = True
                 else:
                     state["chunks"] = None  # over the cap: failover disabled for this session
             try:
                 out = x
+                groups_advanced = 0
                 for block, span in state["route"]:
                     out = block.decode_np(out, session_id, reset=reset, span=span)
+                    groups_advanced += 1
             except Exception as e:
+                from hivemind_tpu.telemetry.serving import is_overload_error
+
+                if is_overload_error(e) and groups_advanced == 0:
+                    # a typed shed (fair-share admission / bounded queue) is NOT
+                    # a dead peer: the server session is intact and re-prefilling
+                    # would only spend more of the very budget that ran out.
+                    # Undo this step's history append so the caller can back off
+                    # and retry the same step cleanly, and surface the shed.
+                    # ONLY valid when no group advanced — a shed deeper in the
+                    # pipeline means upstream groups already appended this step
+                    # to their KV sessions, and a clean retry would double-feed
+                    # them (silent divergence); that case falls through to the
+                    # full re-prefill failover below, which rebuilds every
+                    # group's cache consistently (or fails loudly).
+                    if step_appended:
+                        state["chunks"].pop()
+                        state["positions"] -= x.shape[1]
+                    raise
                 if state["chunks"] is None:
                     raise  # history over the retention cap (or disabled): no failover
                 history = np.concatenate(state["chunks"], axis=1)

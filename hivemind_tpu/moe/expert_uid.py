@@ -42,6 +42,14 @@ def split_uid(uid_or_prefix: str) -> Tuple[ExpertPrefix, int]:
     return uid_or_prefix[:pivot], int(uid_or_prefix[pivot:])
 
 
+class ReplicaInfo(NamedTuple):
+    """One server hosting an expert: its peer id plus the wire dtype that
+    server's declaration advertised (None = unknown, negotiate via rpc_info)."""
+
+    peer_id: PeerID
+    compression: Optional[str] = None
+
+
 class ExpertInfo(NamedTuple):
     uid: ExpertUID
     peer_id: PeerID
@@ -49,3 +57,15 @@ class ExpertInfo(NamedTuple):
     # when its DHT declaration carried one; None = unknown (the client falls
     # back to the rpc_info negotiation on first use)
     compression: Optional[str] = None
+    # the FULL replica set declared for this uid (ISSUE 13), primary included;
+    # None/empty = single-replica record (peer_id is the only server). peer_id
+    # above is the *selected* primary — clients load-balance across `replicas`
+    # by scorecard latency with breaker-aware failover (moe/client/expert.py)
+    replicas: Optional[Tuple[ReplicaInfo, ...]] = None
+
+    @property
+    def replica_set(self) -> Tuple[ReplicaInfo, ...]:
+        """Every known replica (always non-empty; falls back to the primary)."""
+        if self.replicas:
+            return self.replicas
+        return (ReplicaInfo(self.peer_id, self.compression),)

@@ -31,7 +31,7 @@ Serving data path (ISSUE 10, the PR 5 playbook applied to this layer):
 from __future__ import annotations
 
 import time
-from typing import AsyncIterator, Dict, List
+from typing import AsyncIterator, Dict, List, Optional
 
 import numpy as np
 
@@ -84,25 +84,67 @@ class ConnectionHandler(ServicerBase):
 
     def __init__(self, backends: Dict[str, ModuleBackend], decode_max_len: int = 256,
                  decode_max_sessions: int = 64, max_queue_size: int = 1024,
-                 activation_compression: str = "float16"):
+                 activation_compression: str = "float16",
+                 client_rate: Optional[float] = None,
+                 client_burst: Optional[float] = None):
         from hivemind_tpu.moe.server.decode_session import DecodeSessionManager
 
         self.backends = backends
         self.activation_codec = resolve_activation_codec(activation_compression)
         self.forward_pools: Dict[str, TaskPool] = {}
         self.backward_pools: Dict[str, TaskPool] = {}
+        self._max_queue_size = max_queue_size
         self.decode_sessions = DecodeSessionManager(
             backends, max_len=decode_max_len, max_sessions=decode_max_sessions
         )
+        # fair-share admission (ISSUE 13): per-client token buckets ahead of the
+        # bounded queues — one hot tenant sheds at its own budget, typed exactly
+        # like a queue shed, while other clients keep flowing. Opt-in.
+        self.admission = None
+        if client_rate:
+            from hivemind_tpu.moe.server.admission import FairShareAdmission
+
+            self.admission = FairShareAdmission(client_rate, burst=client_burst)
         for name, backend in backends.items():
-            self.forward_pools[name] = TaskPool(
-                backend.forward, f"{name}_forward", max_batch_size=backend.max_batch_size,
-                max_queue_size=max_queue_size,
-            )
-            self.backward_pools[name] = TaskPool(
-                backend.backward, f"{name}_backward", max_batch_size=backend.max_batch_size,
-                max_queue_size=max_queue_size,
-            )
+            self._register_pools(name, backend)
+
+    def _register_pools(self, name: str, backend: ModuleBackend) -> None:
+        self.forward_pools[name] = TaskPool(
+            backend.forward, f"{name}_forward", max_batch_size=backend.max_batch_size,
+            max_queue_size=self._max_queue_size,
+        )
+        self.backward_pools[name] = TaskPool(
+            backend.backward, f"{name}_backward", max_batch_size=backend.max_batch_size,
+            max_queue_size=self._max_queue_size,
+        )
+
+    def add_backend(self, uid: str, backend: ModuleBackend) -> List[TaskPool]:
+        """Register a backend acquired at runtime (expert replication): pools
+        are created here; the caller (Server.add_backend) hands them to the
+        Runtime and re-declares. Returns the new pools."""
+        if uid in self.backends and uid in self.forward_pools:
+            return []
+        self.backends[uid] = backend
+        self._register_pools(uid, backend)
+        return [self.forward_pools[uid], self.backward_pools[uid]]
+
+    def _admit(self, context: P2PContext, tensors, kind: str) -> None:
+        """Fair-share gate: draw this request's sample count from the calling
+        client's token bucket (raises the typed ClientOverBudgetError shed).
+        Runs inside the serving span so sheds stay attributed per client."""
+        if self.admission is None:
+            return
+        cost = 1.0
+        if tensors:
+            first = tensors[0]
+            if getattr(first, "ndim", 0):
+                # samples, not requests: batching harder must not dodge the
+                # budget. Decode steps are [batch, positions, hid] — charge
+                # positions too (a prefill is prompt_len tokens of work).
+                cost = float(first.shape[0])
+                if getattr(first, "ndim", 0) >= 3:
+                    cost *= float(first.shape[1])
+        self.admission.admit(str(context.remote_id), cost, kind=kind)
 
     @property
     def activation_compression(self) -> str:
@@ -241,6 +283,7 @@ class ConnectionHandler(ServicerBase):
         _SERVER_BYTES_RECEIVED.inc(request.ByteSize())
         inputs = await self._deserialize_request(request.tensors)
         with self._serving_trace("forward", request.uid, context, inputs) as span:
+            self._admit(context, inputs, "forward")
             uids = self._span_uids(request.uid, request.metadata)
             if span is not None and len(uids) > 1:
                 span.set("span_len", len(uids))
@@ -251,6 +294,7 @@ class ConnectionHandler(ServicerBase):
         _SERVER_BYTES_RECEIVED.inc(request.ByteSize())
         inputs = await self._deserialize_request(request.tensors)
         with self._serving_trace("backward", request.uid, context, inputs) as span:
+            self._admit(context, inputs, "backward")
             uids = self._span_uids(request.uid, request.metadata)
             if span is not None and len(uids) > 1:
                 span.set("span_len", len(uids))
@@ -283,8 +327,47 @@ class ConnectionHandler(ServicerBase):
         _SERVER_BYTES_RECEIVED.inc(request.ByteSize())
         tensors = await self._deserialize_request(request.tensors)
         with self._serving_trace("decode", request.uid, context, tensors):
+            self._admit(context, tensors, "decode")
             output = await self._run_decode(request.uid, request.metadata, tensors)
             return await self._respond([output])
+
+    async def rpc_replica_state(
+        self, request: runtime_pb2.ExpertUID, context: P2PContext
+    ) -> AsyncIterator[runtime_pb2.ExpertResponse]:
+        """Expert replication transfer (ISSUE 13): stream this expert's
+        construction spec + full ``state_dict`` blob to a peer acquiring a
+        replica. First message carries msgpack metadata (spec, byte length,
+        blake2b digest); the blob follows in 1 MiB chunks riding Tensor
+        buffers. Backends without a ``replication_spec`` (e.g. checkpoint-
+        loaded Llama blocks) refuse — they replicate by loading the same
+        checkpoint, not over RPC."""
+        import hashlib
+
+        backend = self.backends.get(request.uid)
+        if backend is None:
+            raise KeyError(f"unknown expert {request.uid!r}")
+        spec = getattr(backend, "replication_spec", None)
+        if spec is None:
+            raise ValueError(
+                f"expert {request.uid!r} carries no replication spec; "
+                f"replicate it from its source checkpoint instead"
+            )
+        blob = await run_in_executor(backend.state_dict)
+        digest = hashlib.blake2b(blob, digest_size=16).hexdigest()
+        yield runtime_pb2.ExpertResponse(
+            metadata=MSGPackSerializer.dumps({
+                "spec": dict(spec),
+                "total_bytes": len(blob),
+                "digest": digest,
+            })
+        )
+        view = memoryview(blob)
+        for offset in range(0, len(blob), _STREAM_CHUNK):
+            chunk = bytes(view[offset:offset + _STREAM_CHUNK])
+            _SERVER_BYTES_SENT.inc(len(chunk))
+            yield runtime_pb2.ExpertResponse(
+                tensors=[runtime_pb2.Tensor(buffer=chunk, dtype="uint8")]
+            )
 
     # NOTE on the stream RPCs below: the serving span must not wrap a `yield`
     # (an async generator's body runs in its consumer's context), so it closes
@@ -302,6 +385,7 @@ class ConnectionHandler(ServicerBase):
                 span.set("expert", uid)
                 if tensors and getattr(tensors[0], "ndim", 0):
                     span.set("batch", int(tensors[0].shape[0]))
+            self._admit(context, tensors, "decode")
             output = await self._run_decode(uid, metadata, tensors)
         async for message in self._stream_response([output]):
             yield message
@@ -315,6 +399,7 @@ class ConnectionHandler(ServicerBase):
                 span.set("expert", uid)
                 if tensors and getattr(tensors[0], "ndim", 0):
                     span.set("batch", int(tensors[0].shape[0]))
+            self._admit(context, tensors, "forward")
             outputs = await self._run_forward_span(self._span_uids(uid, metadata), tensors)
         async for message in self._stream_response(outputs):
             yield message
@@ -328,6 +413,7 @@ class ConnectionHandler(ServicerBase):
                 span.set("expert", uid)
                 if tensors and getattr(tensors[0], "ndim", 0):
                     span.set("batch", int(tensors[0].shape[0]))
+            self._admit(context, tensors, "backward")
             grads = await self._run_backward_span(self._span_uids(uid, metadata), tensors)
         async for message in self._stream_response(grads):
             yield message

@@ -1,0 +1,317 @@
+"""Hot-expert replication (ISSUE 13): the control loop that turns one-server
+experts into replica sets.
+
+The loop has two halves, both periodic and both driven by data the serving
+stack already produces:
+
+- **Advertise** (every server): experts whose recent ServingLedger QPS or
+  batch occupancy crosses the policy thresholds — and whose DHT replica set is
+  still below ``max_replicas`` — are advertised under the well-known key
+  ``replica_wanted.<grid_root>`` (subkey = expert uid, value = the advertising
+  server's ``peer|codec`` record, short expiration). The advert names exactly
+  where a volunteer can fetch the weights.
+- **Acquire** (servers started with ``replica_slots > 0``): watched grids'
+  ``replica_wanted`` records are scanned; for each wanted uid this server does
+  not already host (and whose replica set is still short), the expert's
+  construction spec + ``state_dict`` blob stream over the source server's
+  ``rpc_replica_state`` (digest-verified), a fresh ModuleBackend is built from
+  the layer registry, registered live into the ConnectionHandler/Runtime
+  (``Server.add_backend``), and declared — from that declaration on, clients
+  resolve a multi-value replica set and start balancing/hedging across it.
+
+Replication is *serving* capacity: an acquired replica answers rpc_forward /
+rpc_decode with bit-equal weights at acquisition time. Backward traffic keeps
+training whichever replica it lands on (replicas drift like any two
+data-parallel workers between averaging rounds); training-grade consistency
+remains the averager's job, not this loop's.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import time
+from typing import Dict, List, NamedTuple, Optional, Sequence
+
+from hivemind_tpu.moe.expert_uid import UID_DELIMITER
+from hivemind_tpu.proto import runtime_pb2
+from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
+from hivemind_tpu.telemetry.serving import SERVING_LEDGER
+from hivemind_tpu.utils.asyncio_utils import run_in_executor
+from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.serializer import MSGPackSerializer
+from hivemind_tpu.utils.timed_storage import get_dht_time
+
+logger = get_logger(__name__)
+
+REPLICA_WANTED_PREFIX = "replica_wanted."
+
+_HOT_EXPERTS = _TELEMETRY.gauge(
+    "hivemind_moe_replication_hot_experts",
+    "locally served experts currently over the replication policy's QPS/occupancy thresholds",
+)
+_ADVERTS = _TELEMETRY.counter(
+    "hivemind_moe_replication_adverts_total",
+    "replica_wanted adverts stored for hot local experts",
+)
+_ACQUIRED = _TELEMETRY.counter(
+    "hivemind_moe_replication_acquired_total",
+    "expert replicas acquired over rpc_replica_state and registered live",
+)
+
+
+class ReplicationPolicy(NamedTuple):
+    """When is an expert hot, and how far may it replicate."""
+
+    qps_threshold: float = 4.0       # recent requests/s that make an expert hot
+    occupancy_threshold: float = 0.5  # or: mean device-batch occupancy this hot
+    max_replicas: int = 2            # replica-set ceiling (adverts stop here)
+    period: float = 10.0             # control-loop cadence, seconds
+
+
+def grid_root(uid: str) -> str:
+    return uid.split(UID_DELIMITER, 1)[0]
+
+
+def build_backend_from_spec(uid: str, spec: Dict, blob: bytes):
+    """Reconstruct a donor's expert from its replication spec + state blob:
+    module from the layer registry, weights/optimizer state from the verified
+    ``state_dict`` stream (bit-equal to the donor at transfer time)."""
+    import optax
+
+    from hivemind_tpu.moe.server.layers import name_to_block, name_to_input
+    from hivemind_tpu.moe.server.module_backend import ModuleBackend
+
+    import flax.serialization
+
+    expert_cls = spec["expert_cls"]
+    hidden_dim = int(spec["hidden_dim"])
+    module = name_to_block[expert_cls](hidden_dim, **(spec.get("expert_kwargs") or {}))
+    sample = name_to_input[expert_cls](4, hidden_dim)
+    sample_kwargs = (
+        {"sample_inputs": sample} if isinstance(sample, tuple) else {"sample_input": sample}
+    )
+    backend = ModuleBackend(
+        uid, module, optimizer=optax.adam(1e-3), **sample_kwargs,
+        max_batch_size=int(spec.get("max_batch_size", 4096)),
+    )
+    # template-free restore: only the PARAMS move (serving capacity) — the
+    # donor's optimizer state has whatever structure its optim_factory chose,
+    # which this server cannot reconstruct; load_params restarts optimizer
+    # statistics for the transferred weights (module_backend.py semantics)
+    restored = flax.serialization.msgpack_restore(blob)
+    backend.load_params(restored["params"])
+    backend.update_count = int(restored.get("updates", 0))
+    backend.replication_spec = dict(spec)
+    return backend
+
+
+async def fetch_replica_state(p2p, source_peer_id, uid: str, chunk_timeout: float = 30.0):
+    """Stream ``rpc_replica_state`` from the donor; returns ``(spec, blob)``
+    after digest verification (a truncated/corrupt transfer never builds a
+    backend)."""
+    stream = p2p.iterate_protobuf_handler(
+        source_peer_id,
+        "ConnectionHandler.rpc_replica_state",
+        runtime_pb2.ExpertUID(uid=uid),
+        runtime_pb2.ExpertResponse,
+    )
+    meta: Optional[Dict] = None
+    chunks: List[bytes] = []
+    async for message in stream:
+        if meta is None:
+            meta = MSGPackSerializer.loads(message.metadata)
+            continue
+        for tensor in message.tensors:
+            chunks.append(tensor.buffer)
+    if meta is None:
+        raise ConnectionError(f"replica state stream for {uid!r} ended before metadata")
+    blob = b"".join(chunks)
+    if len(blob) != int(meta["total_bytes"]):
+        raise ConnectionError(
+            f"replica state for {uid!r} truncated: {len(blob)}/{meta['total_bytes']} bytes"
+        )
+    digest = hashlib.blake2b(blob, digest_size=16).hexdigest()
+    if digest != meta["digest"]:
+        raise ValueError(f"replica state for {uid!r} failed digest verification")
+    return meta["spec"], blob
+
+
+class ReplicationManager:
+    """One per Server (started from ``Server._start`` when replication is on);
+    runs on the server's event loop."""
+
+    def __init__(
+        self,
+        server,
+        *,
+        replica_slots: int = 0,
+        policy: Optional[ReplicationPolicy] = None,
+        watch_grids: Optional[Sequence[str]] = None,
+    ):
+        self.server = server
+        self.replica_slots = replica_slots
+        self.policy = policy or ReplicationPolicy()
+        self._explicit_watch = list(watch_grids) if watch_grids is not None else None
+        self.acquired: List[str] = []
+        self._last_requests: Dict[str, float] = {}
+        self._last_check: Optional[float] = None
+        self._task: Optional[asyncio.Task] = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._loop())
+
+    def shutdown(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logger.warning(f"replication tick failed: {e!r}")
+            await asyncio.sleep(self.policy.period)
+
+    def watched_grids(self) -> List[str]:
+        if self._explicit_watch is not None:
+            return self._explicit_watch
+        return sorted({grid_root(uid) for uid in self.server.backends})
+
+    # ------------------------------------------------------------ hot detection
+
+    def hot_experts(self) -> List[str]:
+        """Locally served experts over the policy thresholds, judged on the
+        ServingLedger: QPS as the request-count delta since the last tick, and
+        mean device-batch occupancy over the recent record window."""
+        stats = SERVING_LEDGER.expert_stats()
+        now = time.monotonic()
+        interval = (now - self._last_check) if self._last_check is not None else None
+        self._last_check = now
+        occupancy: Dict[str, List[float]] = {}
+        for record in SERVING_LEDGER.records(limit=128):
+            if "occupancy" in record:
+                occupancy.setdefault(record["expert"], []).append(float(record["occupancy"]))
+        hot = []
+        for uid, entry in stats.items():
+            if uid not in self.server.backends:
+                continue
+            requests = float(entry.get("requests", 0))
+            previous = self._last_requests.get(uid, requests if interval is None else 0.0)
+            self._last_requests[uid] = requests
+            if interval is None or interval <= 0:
+                continue
+            qps = max(requests - previous, 0.0) / interval
+            mean_occupancy = 0.0
+            if occupancy.get(uid):
+                mean_occupancy = sum(occupancy[uid]) / len(occupancy[uid])
+            if qps >= self.policy.qps_threshold or (
+                qps > 0 and mean_occupancy >= self.policy.occupancy_threshold
+            ):
+                hot.append(uid)
+        _HOT_EXPERTS.set(len(hot))
+        return hot
+
+    # ------------------------------------------------------------ control loop
+
+    async def tick(self) -> None:
+        hot = self.hot_experts()
+        if hot:
+            await self._advertise(hot)
+        if self.replica_slots > len(self.acquired):
+            await self._acquire_one()
+
+    async def _replica_counts(self, uids: Sequence[str]) -> Dict[str, int]:
+        from hivemind_tpu.moe.server.dht_handler import parse_expert_replicas
+
+        async def _count(_dht, node):
+            found = await node.get_many(list(uids))
+            out = {}
+            for uid in uids:
+                entry = found.get(uid)
+                out[uid] = len(parse_expert_replicas(entry.value)) if entry is not None else 0
+            return out
+
+        return await asyncio.wrap_future(
+            self.server.dht.run_coroutine(_count, return_future=True)
+        )
+
+    async def _advertise(self, hot: Sequence[str]) -> None:
+        """Store replica_wanted adverts for hot experts still short of
+        max_replicas (the DHT read doubles as the replica-count check)."""
+        from hivemind_tpu.moe.server.dht_handler import make_expert_record
+
+        counts = await self._replica_counts(hot)
+        wanted = [uid for uid in hot if counts.get(uid, 0) < self.policy.max_replicas]
+        if not wanted:
+            return
+        record = make_expert_record(
+            self.server.dht.peer_id.to_base58(),
+            self.server.handler.activation_compression,
+        )
+        expiration = get_dht_time() + self.policy.period * 3
+        keys = [REPLICA_WANTED_PREFIX + grid_root(uid) for uid in wanted]
+
+        async def _store(_dht, node):
+            return await node.store_many(
+                keys, [record] * len(wanted), [expiration] * len(wanted),
+                subkeys=list(wanted),
+            )
+
+        await asyncio.wrap_future(self.server.dht.run_coroutine(_store, return_future=True))
+        _ADVERTS.inc(len(wanted))
+        logger.info(f"advertised replica_wanted for hot experts: {wanted}")
+
+    async def _acquire_one(self) -> None:
+        """Scan watched grids' adverts; acquire the first wanted expert this
+        server does not already host (one per tick — acquisition moves weights)."""
+        from hivemind_tpu.moe.server.dht_handler import parse_expert_record
+
+        grids = self.watched_grids()
+        if not grids:
+            return
+
+        async def _scan(_dht, node):
+            found = await node.get_many([REPLICA_WANTED_PREFIX + grid for grid in grids])
+            wanted = {}
+            for entry in found.values():
+                if entry is None or not isinstance(entry.value, dict):
+                    continue
+                for subkey, stored in entry.value.items():
+                    value = getattr(stored, "value", stored)
+                    parsed = parse_expert_record(value)
+                    if parsed is not None and isinstance(subkey, str):
+                        wanted[subkey] = parsed
+            return wanted
+
+        wanted = await asyncio.wrap_future(self.server.dht.run_coroutine(_scan, return_future=True))
+        candidates = {
+            uid: source for uid, source in wanted.items()
+            if uid not in self.server.backends and source[0] != self.server.dht.peer_id
+        }
+        if not candidates:
+            return
+        counts = await self._replica_counts(sorted(candidates))
+        for uid in sorted(candidates):
+            if counts.get(uid, 0) >= self.policy.max_replicas:
+                continue
+            source_peer, _compression = candidates[uid]
+            try:
+                p2p = await self.server.dht.replicate_p2p()
+                spec, blob = await fetch_replica_state(p2p, source_peer, uid)
+                backend = await run_in_executor(build_backend_from_spec, uid, spec, blob)
+            except Exception as e:
+                logger.warning(f"could not acquire replica of {uid!r} from {source_peer}: {e!r}")
+                continue
+            await self.server.add_backend(uid, backend)
+            self.acquired.append(uid)
+            _ACQUIRED.inc()
+            logger.info(
+                f"acquired replica of {uid!r} from {source_peer} "
+                f"({len(blob)} state bytes, digest-verified); now serving + declared"
+            )
+            return

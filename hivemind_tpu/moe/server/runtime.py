@@ -45,6 +45,9 @@ class Runtime:
         self.pools = list(pools)
         self.stats_report_interval = stats_report_interval
         self._task: Optional[asyncio.Task] = None
+        # set by add_pool so the drain loop's wait wakes for pools registered
+        # mid-wait (ISSUE 13 replication) without any polling timeout
+        self._pools_changed = asyncio.Event()
         self._last_report = time.perf_counter()
         # drain-loop utilization (ISSUE 9): busy seconds over a rolling window —
         # 1.0 with growing queues means the device executor is the bottleneck;
@@ -74,9 +77,38 @@ class Runtime:
     def start(self) -> None:
         self._task = asyncio.create_task(self._run())
 
+    def add_pool(self, pool: TaskPool) -> None:
+        """Register a pool created after start() (ISSUE 13 expert replication:
+        a server acquires a hot expert at runtime). Runs on the runtime's own
+        loop; `_pools_changed` wakes the drain wait so the new pool is picked
+        up immediately."""
+        if pool in self.pools:
+            return
+        self.pools.append(pool)
+        self._pools_changed.set()
+        children = (
+            _BATCHES.labels(pool.name),
+            _SAMPLES.labels(pool.name),
+            _BATCH_LATENCY.labels(pool.name),
+        )
+        self._children[pool.name] = children
+        self._reported.setdefault(
+            pool.name, (children[0].value, children[1].value, children[2].sum)
+        )
+
     async def _run(self) -> None:
         while True:
+            if not self.pools:
+                # a replica-slot server starts empty and gains pools at runtime
+                self._pools_changed.clear()
+                await self._pools_changed.wait()
+                continue
+            self._pools_changed.clear()
             waiters = [asyncio.create_task(pool.wait_for_tasks()) for pool in self.pools]
+            # a pool added mid-wait (add_pool) has no waiter in this set — its
+            # event wakes the wait so the next iteration picks the new pool up
+            # immediately, with no polling timeout on idle servers
+            waiters.append(asyncio.create_task(self._pools_changed.wait()))
             try:
                 await asyncio.wait(waiters, return_when=asyncio.FIRST_COMPLETED)
             finally:

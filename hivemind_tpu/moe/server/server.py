@@ -44,6 +44,12 @@ class Server:
         decode_max_sessions: int = 64,
         max_queue_size: int = 1024,
         activation_compression: str = "float16",
+        client_rate: Optional[float] = None,
+        client_burst: Optional[float] = None,
+        replica_slots: int = 0,
+        replicate_hot_experts: bool = False,
+        replication_policy=None,
+        replication_watch_grids: Optional[Sequence[str]] = None,
         loop_runner: Optional[LoopRunner] = None,
     ):
         self.dht, self.backends = dht, backends
@@ -51,11 +57,22 @@ class Server:
         self.handler = ConnectionHandler(
             backends, decode_max_len=decode_max_len, decode_max_sessions=decode_max_sessions,
             max_queue_size=max_queue_size, activation_compression=activation_compression,
+            client_rate=client_rate, client_burst=client_burst,
         )
         self.runtime = Runtime(self.handler.all_pools())
         self.checkpoint_saver = (
             CheckpointSaver(backends, checkpoint_dir) if checkpoint_dir is not None else None
         )
+        # hot-expert replication (ISSUE 13): advertise hot local experts and/or
+        # acquire other servers' hot experts into spare replica slots
+        self.replication = None
+        if replicate_hot_experts or replica_slots > 0:
+            from hivemind_tpu.moe.server.replication import ReplicationManager
+
+            self.replication = ReplicationManager(
+                self, replica_slots=replica_slots, policy=replication_policy,
+                watch_grids=replication_watch_grids,
+            )
         self._runner = loop_runner if loop_runner is not None else get_loop_runner()
         self._declare_task: Optional[asyncio.Task] = None
         self._ready = threading.Event()
@@ -79,6 +96,12 @@ class Server:
         decode_max_sessions: int = 64,
         max_queue_size: int = 1024,
         activation_compression: str = "float16",
+        client_rate: Optional[float] = None,
+        client_burst: Optional[float] = None,
+        replica_slots: int = 0,
+        replicate_hot_experts: bool = False,
+        replication_policy=None,
+        replication_watch_grids: Optional[Sequence[str]] = None,
         start: bool = False,
         **backend_kwargs,
     ) -> "Server":
@@ -94,8 +117,11 @@ class Server:
         if dht is None:
             dht = DHT(initial_peers=initial_peers, start=True)
         if expert_uids is None:
-            assert num_experts is not None, "provide either expert_uids or num_experts"
-            expert_uids = _generate_uids(num_experts, expert_pattern or f"expert.[0:{2**30}]", dht)
+            if num_experts is None and replica_slots > 0:
+                expert_uids = []  # replica-only volunteer: starts empty, acquires hot experts
+            else:
+                assert num_experts is not None, "provide either expert_uids or num_experts"
+                expert_uids = _generate_uids(num_experts, expert_pattern or f"expert.[0:{2**30}]", dht)
         optim_factory = optim_factory or (lambda: optax.adam(1e-3))
 
         backends = {}
@@ -110,13 +136,25 @@ class Server:
                 uid, module, optimizer=optim_factory(), **sample_kwargs,
                 max_batch_size=max_batch_size, **backend_kwargs,
             )
+            # registry-built experts are replicable over rpc_replica_state: the
+            # spec lets an acquiring server reconstruct the module before
+            # loading the transferred state_dict (moe/server/replication.py)
+            backends[uid].replication_spec = {
+                "expert_cls": expert_cls, "hidden_dim": hidden_dim,
+                "expert_kwargs": dict(expert_kwargs or {}),
+                "max_batch_size": max_batch_size,
+            }
         if checkpoint_dir is not None:
             loaded = load_experts(backends, checkpoint_dir)
             if loaded:
                 logger.info(f"restored {loaded} experts from {checkpoint_dir}")
         server = cls(dht, backends, checkpoint_dir=checkpoint_dir, decode_max_len=decode_max_len,
                      decode_max_sessions=decode_max_sessions, max_queue_size=max_queue_size,
-                     activation_compression=activation_compression)
+                     activation_compression=activation_compression,
+                     client_rate=client_rate, client_burst=client_burst,
+                     replica_slots=replica_slots, replicate_hot_experts=replicate_hot_experts,
+                     replication_policy=replication_policy,
+                     replication_watch_grids=replication_watch_grids)
         if start:
             server.run_in_background(await_ready=True)
         return server
@@ -138,8 +176,25 @@ class Server:
         self.runtime.start()
         if self.checkpoint_saver is not None:
             self.checkpoint_saver.start()
+        if self.replication is not None:
+            self.replication.start()
         self._declare_task = asyncio.create_task(self._declare_periodically())
         self._ready.set()
+
+    async def add_backend(self, uid: str, backend: ModuleBackend) -> None:
+        """Register an expert acquired at runtime (replication): handler pools
+        + runtime + an immediate declaration, so clients resolve the grown
+        replica set without waiting a full update period. Runs on the server
+        loop (the ReplicationManager's)."""
+        pools = self.handler.add_backend(uid, backend)
+        for pool in pools:
+            self.runtime.add_pool(pool)
+        declare_experts(
+            self.dht, [uid],
+            expiration_time=get_dht_time() + self.update_period * 3,
+            wait=False,
+            compression=self.handler.activation_compression,
+        )
 
     async def _declare_periodically(self) -> None:
         while True:
@@ -158,6 +213,8 @@ class Server:
         async def _stop():
             if self._declare_task is not None:
                 self._declare_task.cancel()
+            if self.replication is not None:
+                self.replication.shutdown()
             self.runtime.shutdown()
             if self.checkpoint_saver is not None:
                 self.checkpoint_saver.shutdown()

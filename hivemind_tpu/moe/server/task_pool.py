@@ -55,6 +55,12 @@ _OCCUPANCY = _TELEMETRY.histogram(
     ("pool",),
     buckets=(0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
 )
+_CANCELLED_SKIPPED = _TELEMETRY.counter(
+    "hivemind_moe_pool_cancelled_skipped_total",
+    "queued tasks dropped at drain time because their caller already gave up "
+    "(hedge loser cancelled through the mux, abandoned deadline) — compute saved",
+    ("pool",),
+)
 
 
 class ServerOverloadedError(RuntimeError):
@@ -127,6 +133,7 @@ class TaskPool:
         self._wait_histogram = _QUEUE_WAIT.labels(name)
         self._shed_counter = _SHEDS.labels(name)
         self._occupancy_histogram = _OCCUPANCY.labels(name)
+        self._cancelled_counter = _CANCELLED_SKIPPED.labels(name)
         _LIVE_POOLS.add(self)
 
     def _event(self) -> asyncio.Event:
@@ -202,11 +209,17 @@ class TaskPool:
         return oldest
 
     def pop_batch(self) -> List[_Task]:
-        """Remove up to max_batch_size samples' worth of tasks."""
+        """Remove up to max_batch_size samples' worth of tasks. Tasks whose
+        future is already done (the caller was cancelled — a hedge's losing
+        request RESET through the mux, an abandoned deadline) are dropped here
+        instead of burning a device-batch slot on an answer nobody will read."""
         batch, total = [], 0
         popped_at = time.perf_counter()
         while self._queue and total + self._queue[0].batch_size <= self.max_batch_size:
             task = self._queue.popleft()
+            if task.future.done():
+                self._cancelled_counter.inc()
+                continue
             task.popped_pc = popped_at
             batch.append(task)
             total += task.batch_size

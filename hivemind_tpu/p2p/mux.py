@@ -194,6 +194,10 @@ class MuxConnection:
         self._closed = False
         self._read_task: Optional[asyncio.Task] = None
         self._handler_tasks: set = set()
+        # stream_id -> running inbound handler task: a peer's RESET cancels the
+        # handler MID-COMPUTE (ISSUE 13 hedged requests: the losing server must
+        # stop working on an answer nobody will read, not just fail its send)
+        self._stream_handler_tasks: Dict[int, asyncio.Task] = {}
         self._buffered_bytes = 0
         self._max_buffered_bytes = max_buffered_bytes
         self.last_used = time.monotonic()  # LRU key for the connection manager
@@ -286,7 +290,14 @@ class MuxConnection:
             self._streams[stream_id] = stream
             task = asyncio.create_task(self._on_inbound_stream(stream))
             self._handler_tasks.add(task)
-            task.add_done_callback(self._handler_tasks.discard)
+            self._stream_handler_tasks[stream_id] = task
+
+            def _forget_handler(finished, *, stream_id=stream_id):
+                self._handler_tasks.discard(finished)
+                if self._stream_handler_tasks.get(stream_id) is finished:
+                    self._stream_handler_tasks.pop(stream_id, None)
+
+            task.add_done_callback(_forget_handler)
             return
         stream = self._streams.get(stream_id)
         if stream is None:
@@ -313,6 +324,13 @@ class MuxConnection:
                 stream._reset = True
                 stream._send_closed = True
                 self._forget_stream(stream_id)
+                # ...and stop COMPUTING: a still-running inbound handler for
+                # this stream is work nobody will read (a hedge's losing
+                # request, an abandoned call). A handler that already finished
+                # is no longer in the map — its completed response stands.
+                handler_task = self._stream_handler_tasks.pop(stream_id, None)
+                if handler_task is not None and not handler_task.done():
+                    handler_task.cancel()
 
     def _forget_stream(self, stream_id: int) -> None:
         stream = self._streams.pop(stream_id, None)

@@ -53,10 +53,13 @@ logger = get_logger(__name__)
 # this ledger reacts to (everything else is one failed string compare)
 SERVING_SPAN = "serving.request"
 
-# the server's typed load-shed error travels as "ServerOverloadedError: <msg>"
-# inside P2PHandlerError text (mux ERROR frames carry type name + message), so
-# the client recognizes a shed without importing the server module
+# the server's typed load-shed errors travel as "<TypeName>: <msg>" inside
+# P2PHandlerError text (mux ERROR frames carry type name + message), so the
+# client recognizes a shed without importing the server module. Two kinds:
+# the pool's bounded-queue shed, and the fair-share admission shed (ISSUE 13,
+# a subclass — one hot client over its token budget while others keep flowing)
 OVERLOAD_ERROR_NAME = "ServerOverloadedError"
+OVERLOAD_ERROR_NAMES = (OVERLOAD_ERROR_NAME, "ClientOverBudgetError")
 
 # phase attributes the TaskPool / handler stamp onto the serving span
 _PHASE_FIELDS = ("queue_wait_s", "assembly_s", "compute_s", "serialize_s")
@@ -94,12 +97,29 @@ WIRE_BYTES_RECEIVED = REGISTRY.counter(
     ("direction",),
 )
 
+# replica robustness accounting (ISSUE 13): hedges fired when an in-flight
+# request crossed the expert's scorecard p95, who won the race, and failovers
+# onto another replica after a shed / connection loss. Client-side counters
+# (this process as the caller), cataloged in docs/observability.md.
+HEDGES = REGISTRY.counter(
+    "hivemind_moe_hedge_total",
+    "hedged expert requests by outcome (fired / primary_won / hedge_won)",
+    ("outcome",),
+)
+REPLICA_FAILOVERS = REGISTRY.counter(
+    "hivemind_moe_replica_failover_total",
+    "expert calls retried on another replica after a typed shed or connection loss",
+    ("kind",),
+)
+
 
 def is_overload_error(error: BaseException) -> bool:
-    """True when ``error`` is (or wraps, across the RPC boundary) the server's
-    typed load-shed answer. String-matched so the client side needs no import
-    of the server module and a P2PHandlerError re-raise still classifies."""
-    return OVERLOAD_ERROR_NAME in f"{type(error).__name__}: {error}"
+    """True when ``error`` is (or wraps, across the RPC boundary) one of the
+    server's typed load-shed answers. String-matched so the client side needs
+    no import of the server module and a P2PHandlerError re-raise still
+    classifies."""
+    text = f"{type(error).__name__}: {error}"
+    return any(name in text for name in OVERLOAD_ERROR_NAMES)
 
 
 def accrue_span_phase(key: str, seconds: float) -> None:
@@ -207,7 +227,7 @@ class ServingLedger:
             if error_type is not None:
                 self._totals["errors"] += 1
                 stats.errors += 1
-                if error_type == OVERLOAD_ERROR_NAME:
+                if error_type in OVERLOAD_ERROR_NAMES:
                     self._totals["sheds"] += 1
                     stats.sheds += 1
             client = self._client_stats(record["client"])
@@ -415,10 +435,11 @@ class ExpertScorecards:
     DHT snapshot so the operator sees which experts are slow or shedding from
     the *caller's* side, not just the server's."""
 
-    def __init__(self, max_experts: int = 256, window: int = 128):
+    def __init__(self, max_experts: int = 256, window: int = 128, max_replicas: int = 8):
         self._lock = threading.Lock()
         self._max_experts = max_experts
         self._window = window
+        self._max_replicas = max_replicas  # per-card replica sub-entries (bounded)
         self._cards: Dict[str, Dict[str, Any]] = {}
 
     def record(
@@ -443,14 +464,7 @@ class ExpertScorecards:
             else:
                 outcome = "failures"
         with self._lock:
-            card = self._cards.get(uid)
-            if card is None:
-                if len(self._cards) >= self._max_experts:
-                    self._cards.pop(next(iter(self._cards)), None)
-                card = self._cards[uid] = {
-                    "requests": 0, "ok": 0, "failures": 0, "timeouts": 0, "sheds": 0,
-                    "durations": deque(maxlen=self._window), "kinds": {},
-                }
+            card = self._card(uid)
             card["requests"] += 1
             card["kinds"][kind] = card["kinds"].get(kind, 0) + 1
             if outcome == "ok":
@@ -460,6 +474,92 @@ class ExpertScorecards:
                 card[outcome] += 1
                 card["last_error"] = f"{type(error).__name__}: {error}"[:200] if error else outcome
 
+    # ------------------------------------------------------------ replica level
+
+    def _card(self, uid: str) -> Dict[str, Any]:
+        card = self._cards.get(uid)
+        if card is None:
+            if len(self._cards) >= self._max_experts:
+                self._cards.pop(next(iter(self._cards)), None)
+            card = self._cards[uid] = {
+                "requests": 0, "ok": 0, "failures": 0, "timeouts": 0, "sheds": 0,
+                "durations": deque(maxlen=self._window), "kinds": {},
+            }
+        return card
+
+    def record_replica(self, uid: str, replica: str, seconds: float, ok: bool,
+                       shed: bool = False) -> None:
+        """One per-replica attempt outcome (ISSUE 13): feeds the latency view
+        :meth:`replica_latency` that RemoteExpert load-balances and hedges by.
+        Attempt-level — the uid-level :meth:`record` still fires exactly once
+        per logical call, so existing totals keep their meaning. A hedge's
+        cancelled loser is never recorded here (no outcome happened)."""
+        with self._lock:
+            stats = self._replica_stats(self._card(uid), replica)
+            stats["requests"] += 1
+            if ok:
+                stats["ok"] += 1
+                stats["durations"].append(seconds)
+            elif shed:
+                stats["sheds"] += 1
+            else:
+                stats["failures"] += 1
+
+    def _replica_stats(self, card: Dict[str, Any], replica: str) -> Dict[str, Any]:
+        replicas = card.setdefault("replicas", {})
+        stats = replicas.get(replica)
+        if stats is None:
+            if len(replicas) >= self._max_replicas:
+                replicas.pop(next(iter(replicas)), None)
+            stats = replicas[replica] = {
+                "requests": 0, "ok": 0, "failures": 0, "sheds": 0,
+                "durations": deque(maxlen=self._window),
+            }
+        return stats
+
+    def note_hedge_loss(self, uid: str, replica: str, elapsed: float) -> None:
+        """The hedge's cancelled loser: NOT a failure, NOT a breaker strike —
+        but ``elapsed`` is a real censored observation ("this replica took at
+        least this long"), appended to the replica's latency window so a
+        consistently-hanging replica drifts down the routing order instead of
+        winning the next pick on stale fast quantiles."""
+        with self._lock:
+            stats = self._replica_stats(self._card(uid), replica)
+            stats["durations"].append(elapsed)
+            stats["hedge_losses"] = stats.get("hedge_losses", 0) + 1
+
+    def replica_latency(self, uid: str, replica: str, quantile: float = 0.95
+                        ) -> Optional[float]:
+        """The replica's observed latency quantile — falls back to the expert's
+        uid-level window when this replica is cold; None when both are cold
+        (a cold expert fires no hedge and keeps its seeded initial choice)."""
+        with self._lock:
+            card = self._cards.get(uid)
+            if card is None:
+                return None
+            stats = (card.get("replicas") or {}).get(replica)
+            durations = list(stats["durations"]) if stats and stats["durations"] else None
+            if durations is None:
+                durations = list(card["durations"]) or None
+        if durations is None:
+            return None
+        return _percentile(durations, quantile)
+
+    def replica_health(self, uid: str, replica: str) -> Tuple[float, float]:
+        """``(mean_latency_or_inf, failure_rate)`` for replica ordering: cold
+        replicas sort last among known ones (inf latency) so the seeded rng
+        breaks the tie, and a shedding/failing replica ranks after a clean one."""
+        with self._lock:
+            card = self._cards.get(uid)
+            stats = ((card or {}).get("replicas") or {}).get(replica)
+            if not stats:
+                return float("inf"), 0.0
+            durations = list(stats["durations"])
+            requests = max(stats["requests"], 1)
+            bad = stats["failures"] + stats["sheds"]
+        mean = sum(durations) / len(durations) if durations else float("inf")
+        return mean, bad / requests
+
     def card(self, uid: str) -> Optional[Dict[str, Any]]:
         with self._lock:
             card = self._cards.get(uid)
@@ -468,13 +568,25 @@ class ExpertScorecards:
     @staticmethod
     def _render(uid: str, card: Dict[str, Any]) -> Dict[str, Any]:
         out = {
-            k: v for k, v in card.items() if k not in ("durations", "kinds")
+            k: v for k, v in card.items() if k not in ("durations", "kinds", "replicas")
         }
         out["success_rate"] = round(card["ok"] / max(card["requests"], 1), 4)
         durations = list(card["durations"])
         if durations:
             out.update({f"{k}_s": v for k, v in _quantiles(durations).items()})
         out["kinds"] = dict(card["kinds"])
+        replicas = card.get("replicas")
+        if replicas:
+            rendered = {}
+            for peer, stats in replicas.items():
+                entry = {k: v for k, v in stats.items() if k != "durations"}
+                replica_durations = list(stats["durations"])
+                if replica_durations:
+                    entry.update(
+                        {f"{k}_s": v for k, v in _quantiles(replica_durations).items()}
+                    )
+                rendered[peer] = entry
+            out["replicas"] = rendered
         return out
 
     def snapshot(self, limit: int = 16) -> Dict[str, Dict[str, Any]]:

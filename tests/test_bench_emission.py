@@ -262,6 +262,43 @@ def test_benchmark_llama_serving_smoke():
     assert result["extra"]["activation_compression"] == "float16"
 
 
+def test_benchmark_llama_multi_client_smoke():
+    """ISSUE 13: the skewed multi-client load generator end to end — one hot
+    client + a background client over TWO replicas with fair-share admission
+    armed and one replica crash-killed mid-run. --smoke exits nonzero on any
+    non-shed client-visible failure, on a background-client shed (fair-share
+    violated), or on a client decoding zero tokens."""
+    import subprocess
+    import sys
+
+    script = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks", "benchmark_llama_serving.py",
+    )
+    run = subprocess.run(
+        [sys.executable, script, "--smoke", "--multi_client", "1", "--replicas", "2",
+         "--kill_replica_at", "0.5", "--client_rate", "40", "--platform", "cpu"],
+        timeout=300,
+        capture_output=True,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert run.returncode == 0, f"smoke benchmark failed:\n{run.stdout[-2000:]}\n{run.stderr[-2000:]}"
+    payload = next(line for line in run.stdout.splitlines() if line.startswith("{"))
+    result = json.loads(payload)
+    assert result["metric"] == "llama_multi_client_decode"
+    clients = result["extra"]["clients"]
+    assert set(clients) == {"hot", "bg0"}
+    for name, entry in clients.items():
+        assert entry["failures"] == [], (name, entry)
+        assert entry["tokens"] > 0 and "p99_ms" in entry, (name, entry)
+    # the kill actually happened and the replica set was real
+    assert result["extra"]["killed_replica_at_s"] is not None
+    assert result["extra"]["replicas"] == 2
+    # background client untouched by the hot client's saturation
+    assert clients["bg0"]["sheds"] == 0
+
+
 def test_benchmark_swarm_sim_smoke():
     """ISSUE 12: the swarm simulator end-to-end in --smoke mode — a ~100-peer
     composite (DHT fan-out under churn + link-scoped chaos, matchmaking

@@ -1,0 +1,507 @@
+"""ISSUE 13: serving survives replicas dying and clients misbehaving —
+replica-set DHT records, scorecard-balanced routing with breaker-aware
+failover, hedged requests with exact loser bookkeeping, per-client fair-share
+admission, and the hot-expert replication control loop."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import AsyncIterator, List, Optional
+
+import numpy as np
+import pytest
+
+from hivemind_tpu.moe.expert_uid import ExpertInfo, ReplicaInfo
+from hivemind_tpu.moe.server.dht_handler import (
+    expert_info_from_entry,
+    make_expert_record,
+    parse_expert_replicas,
+)
+from hivemind_tpu.p2p import PeerID
+from hivemind_tpu.utils.crypto import Ed25519PrivateKey
+from hivemind_tpu.utils.timed_storage import ValueWithExpiration
+
+
+def _peer() -> PeerID:
+    return PeerID.from_private_key(Ed25519PrivateKey())
+
+
+# ------------------------------------------------------------------ records
+
+
+def test_replica_record_forms():
+    """Every historical leaf form parses: bare peer, peer|codec, and the
+    ISSUE-13 subkey dictionary; malformed members are skipped, duplicates
+    deduped, order deterministic (sorted by peer id)."""
+    a, b = _peer(), _peer()
+    # legacy plain string (one replica)
+    [replica] = parse_expert_replicas(make_expert_record(a.to_base58(), "float16"))
+    assert replica == ReplicaInfo(a, "float16")
+    [replica] = parse_expert_replicas(a.to_base58())
+    assert replica == ReplicaInfo(a, None)
+    # subkey dictionary: the multi-value replica set
+    entry = {
+        a.to_base58(): ValueWithExpiration(make_expert_record(a.to_base58(), "float16"), 10.0),
+        b.to_base58(): ValueWithExpiration(make_expert_record(b.to_base58(), "none"), 11.0),
+        "junk": ValueWithExpiration("not!!a@@peer", 12.0),
+        "more_junk": ValueWithExpiration(12345, 13.0),
+    }
+    replicas = parse_expert_replicas(entry)
+    assert len(replicas) == 2
+    assert replicas == sorted(replicas, key=lambda r: r.peer_id.to_base58())
+    assert {r.peer_id for r in replicas} == {a, b}
+    # malformed whole value
+    assert parse_expert_replicas(None) == []
+    assert parse_expert_replicas(42) == []
+
+
+def test_expert_info_from_entry_carries_full_set():
+    a, b = sorted((_peer(), _peer()), key=lambda p: p.to_base58())
+    entry = {
+        a.to_base58(): ValueWithExpiration(make_expert_record(a.to_base58(), "float16"), 10.0),
+        b.to_base58(): ValueWithExpiration(make_expert_record(b.to_base58()), 11.0),
+    }
+    info = expert_info_from_entry("grid.0", entry)
+    assert info is not None and info.uid == "grid.0"
+    assert info.peer_id == a  # deterministic primary; clients re-select
+    assert len(info.replica_set) == 2
+    # single-replica ExpertInfo still reports a non-empty replica set
+    solo = ExpertInfo("grid.0", a, "none")
+    assert solo.replica_set == (ReplicaInfo(a, "none"),)
+    assert expert_info_from_entry("grid.0", {"x": ValueWithExpiration("0Il!bad", 1.0)}) is None
+
+
+# ------------------------------------------------------------------ admission
+
+
+def test_fair_share_admission_bucket():
+    from hivemind_tpu.moe.server.admission import ClientOverBudgetError, FairShareAdmission
+    from hivemind_tpu.moe.server.task_pool import ServerOverloadedError
+
+    clock = [0.0]
+    admission = FairShareAdmission(rate_per_s=10.0, burst=20.0, clock=lambda: clock[0])
+    # the burst drains, then the typed shed
+    for _ in range(5):
+        admission.admit("alice", 4.0)
+    with pytest.raises(ClientOverBudgetError) as info:
+        admission.admit("alice", 4.0)
+    assert isinstance(info.value, ServerOverloadedError)  # existing shed contract
+    # other clients keep flowing (their own bucket)
+    admission.admit("bob", 4.0)
+    # refill: 1 second restores 10 tokens
+    clock[0] += 1.0
+    admission.admit("alice", 10.0)
+    with pytest.raises(ClientOverBudgetError):
+        admission.admit("alice", 1.0)
+    assert admission.tokens("alice") < 1.0
+
+
+def test_admission_is_typed_overload_and_bounded():
+    from hivemind_tpu.moe.server.admission import ClientOverBudgetError, FairShareAdmission
+    from hivemind_tpu.telemetry.serving import is_overload_error
+
+    assert is_overload_error(ClientOverBudgetError("client x over budget"))
+    # recognized across the RPC boundary by type-name text, like pool sheds
+    assert is_overload_error(RuntimeError("ClientOverBudgetError: client x over budget"))
+    admission = FairShareAdmission(rate_per_s=1.0, max_clients=4)
+    for index in range(10):
+        admission.admit(f"client{index}", 0.1)
+    assert len(admission) <= 4  # identity cycling cannot grow the map
+
+
+# ------------------------------------------------------------------ hedging (stubbed replicas)
+
+
+class _StubExpert:
+    """Builds a RemoteExpert whose per-replica RPC is scripted: each replica's
+    behavior is a callable returning a result, raising, or hanging forever."""
+
+    def __init__(self, behaviors, uid="stub.0", hedging=True):
+        import types
+
+        from hivemind_tpu.moe.client.expert import RemoteExpert
+
+        self.replicas = [ReplicaInfo(_peer(), "none") for _ in behaviors]
+        self.by_peer = {
+            replica.peer_id: behavior for replica, behavior in zip(self.replicas, behaviors)
+        }
+        info = ExpertInfo(uid, self.replicas[0].peer_id, "none", tuple(self.replicas))
+        self.calls: List[PeerID] = []
+        self.cancelled: List[PeerID] = []
+        outer = self
+        p2p = types.SimpleNamespace(peer_id=_peer())
+        expert = RemoteExpert(info, p2p, seed=7, hedging=hedging)
+
+        async def _call_replica(method, replica, tensors, metadata=b""):
+            outer.calls.append(replica.peer_id)
+            try:
+                return await outer.by_peer[replica.peer_id]()
+            except asyncio.CancelledError:
+                outer.cancelled.append(replica.peer_id)
+                raise
+
+        expert._call_replica = _call_replica
+        self.expert = expert
+
+
+def _warm_replica(uid: str, peer: PeerID, latency: float = 0.01, n: int = 20):
+    from hivemind_tpu.telemetry.serving import SCORECARDS
+
+    for _ in range(n):
+        SCORECARDS.record_replica(uid, peer.to_base58(), latency, ok=True)
+
+
+async def test_hedge_fires_and_loser_is_clean():
+    """The satellite contract: when the primary stalls past its scorecard p95,
+    a hedge races the second replica; the winner's result returns, the loser is
+    CANCELLED and never registers a scorecard failure or a breaker strike."""
+    from hivemind_tpu.moe.client.call_many import EXPERT_BREAKERS
+    from hivemind_tpu.moe.client.expert import replica_breaker_key
+    from hivemind_tpu.telemetry.serving import SCORECARDS
+
+    async def hang():
+        await asyncio.sleep(3600)
+
+    async def fast():
+        await asyncio.sleep(0.005)
+        return [np.ones(2, np.float32)]
+
+    stub = _StubExpert([hang, fast], uid="hedge.0")
+    slow_peer, fast_peer = (replica.peer_id for replica in stub.replicas)
+    # warmed quantiles: the primary looks fast (small p95), so the stall crosses it
+    _warm_replica("hedge.0", slow_peer, latency=0.01)
+    result = await stub.expert._call("forward", [np.zeros(2, np.float32)])
+    assert np.allclose(result[0], 1.0)
+    assert stub.calls[0] == slow_peer and fast_peer in stub.calls  # hedge launched
+    await asyncio.sleep(0.05)  # let the loser's CancelledError deliver
+    assert stub.cancelled == [slow_peer]  # loser cancelled...
+    card = SCORECARDS.card("hedge.0")
+    slow_stats = card["replicas"][slow_peer.to_base58()]
+    assert slow_stats["failures"] == 0 and slow_stats["sheds"] == 0  # ...with NO failure
+    assert slow_stats.get("hedge_losses", 0) == 1  # censored latency only
+    assert replica_breaker_key("hedge.0", slow_peer) not in EXPERT_BREAKERS  # no strike
+    # uid-level outcome: one clean success
+    assert card["ok"] == 1 and card["failures"] == 0
+    from hivemind_tpu.telemetry.serving import REGISTRY
+
+    metric = REGISTRY.get("hivemind_moe_hedge_total")
+    outcomes = {",".join(k): c.value for k, c in metric.series()}
+    assert outcomes.get("fired", 0) >= 1 and outcomes.get("hedge_won", 0) >= 1
+
+
+async def test_no_hedge_while_cold_or_disabled():
+    async def slowish():
+        await asyncio.sleep(0.05)
+        return [np.zeros(1, np.float32)]
+
+    async def fast():
+        return [np.ones(1, np.float32)]
+
+    # cold scorecards: no p95, no hedge — the primary's answer is awaited
+    stub = _StubExpert([slowish, fast], uid="cold.0")
+    await stub.expert._call("forward", [np.zeros(1, np.float32)])
+    assert len(stub.calls) == 1
+    # warmed but hedging disabled
+    stub = _StubExpert([slowish, fast], uid="nohedge.0", hedging=False)
+    _warm_replica("nohedge.0", stub.replicas[0].peer_id, latency=0.001)
+    await stub.expert._call("forward", [np.zeros(1, np.float32)])
+    assert len(stub.calls) == 1
+
+
+async def test_shed_fails_over_to_next_replica():
+    """Satellite: a typed shed on one replica fails over instead of failing
+    the call — and the shed lands on the REPLICA's card, not the uid outcome."""
+    from hivemind_tpu.moe.server.task_pool import ServerOverloadedError
+    from hivemind_tpu.telemetry.serving import REGISTRY, SCORECARDS
+
+    async def shedding():
+        raise ServerOverloadedError("pool full; request shed")
+
+    async def fast():
+        return [np.ones(1, np.float32)]
+
+    stub = _StubExpert([shedding, fast], uid="shed.0")
+    result = await stub.expert._call("forward", [np.zeros(1, np.float32)])
+    assert np.allclose(result[0], 1.0)
+    assert len(stub.calls) == 2
+    card = SCORECARDS.card("shed.0")
+    assert card["ok"] == 1 and card["sheds"] == 0  # the LOGICAL call succeeded
+    assert card["replicas"][stub.replicas[0].peer_id.to_base58()]["sheds"] == 1
+    metric = REGISTRY.get("hivemind_moe_replica_failover_total")
+    assert sum(c.value for _k, c in metric.series()) >= 1
+
+
+async def test_single_replica_shed_propagates_exactly_as_before():
+    """With no second replica the PR 8 contract is untouched: the typed shed
+    reaches the caller, the scorecard counts a shed, the uid breaker strikes."""
+    from hivemind_tpu.moe.client.call_many import EXPERT_BREAKERS
+    from hivemind_tpu.moe.server.task_pool import ServerOverloadedError
+    from hivemind_tpu.telemetry.serving import SCORECARDS
+
+    async def shedding():
+        raise ServerOverloadedError("pool full; request shed")
+
+    stub = _StubExpert([shedding], uid="solo.0")
+    for _ in range(2):
+        with pytest.raises(ServerOverloadedError):
+            await stub.expert._call("forward", [np.zeros(1, np.float32)])
+    card = SCORECARDS.card("solo.0")
+    assert card["sheds"] == 2
+    assert "solo.0" in EXPERT_BREAKERS  # two strikes trip the uid breaker
+
+
+async def test_deterministic_failure_does_not_fail_over():
+    """A deterministic handler error (bad input → ValueError) would fail on
+    every replica: no failover, the error surfaces once."""
+
+    async def broken():
+        raise RuntimeError("ValueError: deliberate schema mismatch")  # not replica-gone
+
+    async def fast():
+        return [np.ones(1, np.float32)]
+
+    stub = _StubExpert([broken, fast], uid="det.0")
+    with pytest.raises(RuntimeError, match="schema mismatch"):
+        await stub.expert._call("forward", [np.zeros(1, np.float32)])
+    assert len(stub.calls) == 1
+
+
+async def test_decode_sessions_stick_to_winning_replica():
+    """Decode prefill may balance/fail over; continuations are pinned to the
+    replica that holds the KV cache."""
+
+    async def fast():
+        return [np.ones(1, np.float32)]
+
+    stub = _StubExpert([fast, fast], uid="dec.0")
+    await stub.expert._call("decode", [np.zeros(1, np.float32)], b"",
+                            session="s1", session_reset=True)
+    pinned = stub.calls[-1]
+    for _ in range(3):
+        await stub.expert._call("decode", [np.zeros(1, np.float32)], b"",
+                                session="s1", session_reset=False)
+    assert all(peer == pinned for peer in stub.calls)
+
+
+def test_cold_replica_choice_is_seeded():
+    """Satellite: the initial replica pick is seeded-random, not 'first
+    declared value' — different seeds spread, the same seed replays."""
+    import types
+
+    from hivemind_tpu.moe.client.expert import RemoteExpert
+
+    replicas = tuple(ReplicaInfo(_peer(), None) for _ in range(4))
+    info = ExpertInfo("seeded.0", replicas[0].peer_id, None, replicas)
+    p2p = types.SimpleNamespace(peer_id=_peer())
+
+    def first_choice(seed):
+        return RemoteExpert(info, p2p, seed=seed)._replica_order()[0].peer_id
+
+    assert first_choice(1) == first_choice(1)  # deterministic per seed
+    firsts = {first_choice(seed).to_base58() for seed in range(12)}
+    assert len(firsts) > 1  # and spread across the set, not always replicas[0]
+
+
+# ------------------------------------------------------------------ mux + pool
+
+
+async def test_mux_reset_cancels_inbound_handler():
+    """Hedge-loser cancellation propagates: the client's RESET must cancel the
+    server's still-running handler (the losing server stops computing)."""
+    from hivemind_tpu.p2p import P2P, P2PContext
+    from hivemind_tpu.proto import test_pb2
+
+    server = await P2P.create()
+    client = await P2P.create()
+    entered = asyncio.Event()
+    cancelled = asyncio.Event()
+
+    async def slow(request: test_pb2.TestRequest, context: P2PContext) -> test_pb2.TestResponse:
+        entered.set()
+        try:
+            await asyncio.sleep(3600)
+        except asyncio.CancelledError:
+            cancelled.set()
+            raise
+        return test_pb2.TestResponse(number=0)
+
+    await server.add_protobuf_handler("slow", slow, test_pb2.TestRequest)
+    await client.connect(server.get_visible_maddrs()[0])
+    call = asyncio.ensure_future(client.call_protobuf_handler(
+        server.peer_id, "slow", test_pb2.TestRequest(number=1), test_pb2.TestResponse
+    ))
+    await asyncio.wait_for(entered.wait(), 10)
+    call.cancel()
+    with pytest.raises(asyncio.CancelledError):
+        await call
+    await asyncio.wait_for(cancelled.wait(), 10)  # the server STOPPED computing
+    await client.shutdown()
+    await server.shutdown()
+
+
+async def test_pop_batch_skips_cancelled_tasks():
+    """A queued task whose caller gave up (future done) is dropped at drain
+    time instead of burning a device-batch slot."""
+    from hivemind_tpu.moe.server.task_pool import TaskPool
+
+    pool = TaskPool(lambda x: [x * 2], "cancel_test", max_batch_size=8)
+
+    async def submit(value):
+        return await pool.submit_task(np.full((1, 2), value, np.float32))
+
+    keeper = asyncio.ensure_future(submit(1.0))
+    loser = asyncio.ensure_future(submit(2.0))
+    await asyncio.sleep(0.01)  # both enqueued
+    loser.cancel()
+    with pytest.raises(asyncio.CancelledError):
+        await loser
+    batch = pool.pop_batch()
+    assert [task.args[0][0, 0] for task in batch] == [1.0]
+    pool.process_batch(batch)
+    [out] = await keeper
+    assert np.allclose(out, 2.0)
+
+
+# ------------------------------------------------------------------ end to end
+
+
+def test_replicated_expert_survives_replica_death():
+    """Two servers declare the same uid → one multi-value record; the client
+    balances across both, and killing one replica is never client-visible."""
+    import optax
+
+    from hivemind_tpu.dht import DHT
+    from hivemind_tpu.moe import RemoteExpert, Server, get_experts
+
+    dht1 = DHT(start=True)
+    maddrs = [str(m) for m in dht1.get_visible_maddrs()]
+    s1 = Server.create(expert_uids=["reptest.0"], expert_cls="ffn", hidden_dim=16,
+                       dht=dht1, start=True, optim_factory=lambda: optax.sgd(1e-3))
+    dht2 = DHT(initial_peers=maddrs, start=True)
+    s2 = Server.create(expert_uids=["reptest.0"], expert_cls="ffn", hidden_dim=16,
+                       dht=dht2, start=True, optim_factory=lambda: optax.sgd(1e-3))
+    client_dht = DHT(initial_peers=maddrs, start=True)
+    try:
+        info = None
+        for _ in range(30):
+            [info] = get_experts(client_dht, ["reptest.0"])
+            if info is not None and len(info.replica_set) == 2:
+                break
+            time.sleep(0.5)
+        assert info is not None and len(info.replica_set) == 2, info
+        expert = RemoteExpert(info, client_dht.node.p2p)
+        x = np.random.RandomState(0).randn(2, 16).astype(np.float32)
+        expert.forward_np(x)
+        s1.shutdown()
+        dht1.shutdown()
+        for _ in range(5):
+            expert.forward_np(x)  # transparent failover: no exception = pass
+    finally:
+        s2.shutdown()
+        dht2.shutdown()
+        client_dht.shutdown()
+
+
+def test_replication_manager_acquires_hot_expert():
+    """The full control loop: traffic makes an expert hot → replica_wanted
+    advert → a replica-slot server fetches spec+state (digest-verified), serves
+    and declares — the client then resolves a two-replica set with bit-close
+    outputs on both."""
+    import optax
+
+    from hivemind_tpu.dht import DHT
+    from hivemind_tpu.moe import RemoteExpert, Server, get_experts
+    from hivemind_tpu.moe.expert_uid import ExpertInfo
+    from hivemind_tpu.moe.server.replication import ReplicationPolicy
+
+    policy = ReplicationPolicy(qps_threshold=1.0, occupancy_threshold=0.5,
+                               max_replicas=2, period=1.0)
+    dht1 = DHT(start=True)
+    maddrs = [str(m) for m in dht1.get_visible_maddrs()]
+    s1 = Server.create(expert_uids=["hotgrid.0"], expert_cls="ffn", hidden_dim=16,
+                       dht=dht1, start=True, optim_factory=lambda: optax.sgd(1e-3),
+                       replicate_hot_experts=True, replication_policy=policy)
+    dht2 = DHT(initial_peers=maddrs, start=True)
+    s2 = Server.create(dht=dht2, start=True, replica_slots=1, replication_policy=policy,
+                       replication_watch_grids=["hotgrid"],
+                       optim_factory=lambda: optax.sgd(1e-3))
+    client_dht = DHT(initial_peers=maddrs, start=True)
+    try:
+        [info] = get_experts(client_dht, ["hotgrid.0"])
+        assert info is not None
+        expert = RemoteExpert(info, client_dht.node.p2p)
+        x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            for _ in range(5):
+                expert.forward_np(x)
+            [info] = get_experts(client_dht, ["hotgrid.0"])
+            if info is not None and len(info.replica_set) == 2:
+                break
+            time.sleep(0.5)
+        assert info is not None and len(info.replica_set) == 2, "replica never acquired"
+        outputs = []
+        for replica in info.replica_set:
+            solo = ExpertInfo("hotgrid.0", replica.peer_id, replica.compression, None)
+            outputs.append(RemoteExpert(solo, client_dht.node.p2p).forward_np(x)[0])
+        # backward traffic may have stepped the donor between transfer and
+        # check: replicas must be CLOSE (weights moved verbatim), not stale
+        np.testing.assert_allclose(outputs[0], outputs[1], atol=1e-3)
+    finally:
+        s1.shutdown()
+        s2.shutdown()
+        dht1.shutdown()
+        dht2.shutdown()
+        client_dht.shutdown()
+
+
+def test_admission_shed_feeds_breakers_and_scorecards():
+    """Fair-share sheds over real RPC stay typed end to end: the client's
+    scorecard counts sheds, the uid breaker accumulates them — exactly the
+    PR 8 shed contract."""
+    import optax
+
+    from hivemind_tpu.dht import DHT
+    from hivemind_tpu.moe import RemoteExpert, Server, get_experts
+    from hivemind_tpu.moe.client.call_many import EXPERT_BREAKERS
+    from hivemind_tpu.telemetry.serving import SCORECARDS, is_overload_error
+
+    dht1 = DHT(start=True)
+    s1 = Server.create(expert_uids=["admtest.0"], expert_cls="ffn", hidden_dim=16,
+                       dht=dht1, start=True, optim_factory=lambda: optax.sgd(1e-3),
+                       client_rate=8.0, client_burst=16.0)
+    client_dht = DHT(initial_peers=[str(m) for m in dht1.get_visible_maddrs()], start=True)
+    try:
+        [info] = get_experts(client_dht, ["admtest.0"])
+        expert = RemoteExpert(info, client_dht.node.p2p)
+        x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+        ok = shed = 0
+        for _ in range(10):
+            try:
+                expert.forward_np(x)
+                ok += 1
+            except Exception as e:
+                assert is_overload_error(e), repr(e)
+                shed += 1
+        assert ok >= 3 and shed >= 2  # burst 16 = 4 requests of 4 samples
+        assert SCORECARDS.card("admtest.0")["sheds"] == shed
+        assert "admtest.0" in EXPERT_BREAKERS
+    finally:
+        s1.shutdown()
+        dht1.shutdown()
+        client_dht.shutdown()
+
+
+@pytest.mark.chaos
+def test_serving_churn_smoke():
+    """The run_chaos_soak --serving phase, short: stall → kill → restart one
+    replica mid-traffic; >=1 hedge fired, zero client-visible failures,
+    breakers recovered (see hivemind_cli/run_chaos_soak.py)."""
+    from hivemind_tpu.hivemind_cli.run_chaos_soak import run_serving_churn
+
+    report = run_serving_churn(duration=30.0, seed=0)
+    assert report["checks"]["hedge_fired"], report
+    assert report["checks"]["zero_client_visible_failures"], report
+    assert report["checks"]["breakers_recovered"], report
+    assert report["ok"], report
