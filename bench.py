@@ -499,6 +499,29 @@ def telemetry_section(averaging=None, serving=None) -> dict:
     return section
 
 
+def lint_section() -> dict:
+    """ISSUE 16: the hivemind-lint summary embedded in every BENCH artifact —
+    per-rule violation/suppressed/allowlisted counts (no finding bodies), so
+    each round records the static health of the exact tree it measured.
+    Defensive: lint trouble must never take the benchmark down."""
+    import os
+    import sys
+
+    try:
+        tools_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
+        if tools_dir not in sys.path:
+            sys.path.insert(0, tools_dir)
+        from lint.engine import run_suite
+
+        summary = run_suite().to_json(include_findings=False)
+        summary["total_stale_allowlist"] = sum(
+            rule.get("stale_allowlist", 0) for rule in summary.get("rules", {}).values()
+        )
+        return summary
+    except Exception as e:
+        return {"error": repr(e)[:200]}
+
+
 def emit(result: dict, out=None, err=None) -> None:
     """Full diagnostics (probe log, controls, errors) go to stderr; stdout's final
     line is the compact metric-first JSON the driver records."""
@@ -571,6 +594,7 @@ def main() -> None:
     result["extra"]["host_control"] = {"at_start": control_start, "at_end": control_end}
     result["tpu_probe_log"] = probe_log
     result["telemetry"] = telemetry_section(averaging, serving)
+    result["lint"] = lint_section()
     if diagnostics:
         result["tpu_measure_errors"] = diagnostics
     emit(result)

@@ -59,6 +59,7 @@ from hivemind_tpu.resilience import CHAOS as _CHAOS
 from hivemind_tpu.resilience import Deadline, RetryPolicy
 from hivemind_tpu.utils.asyncio_utils import anext_safe, enter_asynchronously
 from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.asyncio_utils import spawn
 from hivemind_tpu.utils.loop import LoopRunner, get_loop_runner
 from hivemind_tpu.utils.serializer import MSGPackSerializer
 from hivemind_tpu.utils.timed_storage import DHTExpiration, ValueWithExpiration, get_dht_time
@@ -219,9 +220,9 @@ class DecentralizedAverager(ServicerBase):
         )
         await self.add_p2p_handlers(self.p2p, namespace=self.prefix)
         if self._allow_state_sharing:
-            self._declare_state_task = asyncio.create_task(self._declare_for_download_periodically())
+            self._declare_state_task = spawn(self._declare_for_download_periodically(), name="averager.declare_state")
         # opportunistic: never gates readiness (fire-and-forget task)
-        self._warmup_task = asyncio.create_task(self._warm_data_path())
+        self._warmup_task = spawn(self._warm_data_path(), name="averager.warmup")
         self._ready.set()
 
     async def _warm_data_path(self) -> None:
@@ -271,8 +272,8 @@ class DecentralizedAverager(ServicerBase):
             # construction); without it peers can never discover our state
             async def _ensure_declare_task():
                 if self._declare_state_task is None or self._declare_state_task.done():
-                    self._declare_state_task = asyncio.create_task(
-                        self._declare_for_download_periodically()
+                    self._declare_state_task = spawn(
+                        self._declare_for_download_periodically(), name="averager.declare_state"
                     )
 
             self._runner.run_coroutine(_ensure_declare_task(), return_future=True)
@@ -549,7 +550,7 @@ class DecentralizedAverager(ServicerBase):
         links = self._negotiate_links(group_info, adverts)
         runner = self._make_allreduce_runner(group_info, peer_element_counts, modes, weight, links=links)
         async with self._allreduce_registered:
-            self._running_allreduces[group_info.group_id] = runner
+            self._running_allreduces[group_info.group_id] = runner  # lint: single-writer — holds _allreduce_registered's lock
             self._allreduce_registered.notify_all()
         try:
             iterator = runner.run()
@@ -607,7 +608,7 @@ class DecentralizedAverager(ServicerBase):
             reducer_timeout=self.reducer_timeout,
         )
         async with self._allreduce_registered:
-            self._running_allreduces[group_id] = runner
+            self._running_allreduces[group_id] = runner  # lint: single-writer — holds _allreduce_registered's lock
             self._allreduce_registered.notify_all()
         try:
             averaged = [np.array(t, dtype=np.float32, copy=True) for t in tensors]

@@ -24,7 +24,7 @@ from hivemind_tpu.averaging.key_manager import GroupKeyManager
 from hivemind_tpu.p2p import P2P, P2PContext, P2PHandlerError, PeerID
 from hivemind_tpu.proto import averaging_pb2
 from hivemind_tpu.resilience import RetryPolicy
-from hivemind_tpu.utils.asyncio_utils import anext_safe, cancel_and_wait
+from hivemind_tpu.utils.asyncio_utils import anext_safe, cancel_and_wait, spawn
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.timed_storage import DHTExpiration, get_dht_time
 
@@ -105,7 +105,6 @@ class Matchmaking:
         # follower's round start on a timer instead of an event (ISSUE 6: the
         # measured ~0.7 s/round idle gap on the averaging benchmark)
         self._group_assembled = asyncio.Event()
-        self._background_tasks: set = set()  # strong refs for fire-and-forget retracts
         self._tried_leaders: set = set()
         self._join_in_progress = False  # excludes full-group assembly while we court a leader
         # adaptive lead time (VERDICT r3 #5): a fixed min_matchmaking_time collapses
@@ -191,7 +190,7 @@ class Matchmaking:
                     await self.key_manager.declare_averager(
                         declared_key, self.peer_id, self.declared_expiration_time
                     )
-                declare_task = asyncio.create_task(self._declare_periodically(declared_key))
+                declare_task = spawn(self._declare_periodically(declared_key), name="matchmaking.declare_periodically")
             search_started = get_dht_time()
             wait_started = time.perf_counter()  # the metric must survive clock steps
             group = None
@@ -229,11 +228,7 @@ class Matchmaking:
                         # newest-expiration-wins, so a late retract can never
                         # clobber the next round's declaration; until it lands,
                         # join requests get REJECT_NOT_LOOKING_FOR_GROUP)
-                        retract = asyncio.create_task(
-                            self._retract_declaration(declared_key)
-                        )
-                        self._background_tasks.add(retract)
-                        retract.add_done_callback(self._background_tasks.discard)
+                        spawn(self._retract_declaration(declared_key), name="matchmaking.retract_declaration")
                     if self.current_followers and self.assembled_group is None:
                         self._disband_followers(suggested_leader=None)
 
@@ -313,7 +308,7 @@ class Matchmaking:
         current: Optional[PeerID] = leader
         while current is not None and current not in visited_chain and get_dht_time() < self.declared_expiration_time:
             visited_chain.add(current)
-            self._tried_leaders.add(current)
+            self._tried_leaders.add(current)  # lint: single-writer — one matchmaking cycle per averager
             group = None
             suggested = None
             try:
@@ -389,7 +384,7 @@ class Matchmaking:
             return
         outbox: asyncio.Queue = asyncio.Queue()
         self._note_others_observed()
-        self.current_followers[context.remote_id] = (request, outbox)
+        self.current_followers[context.remote_id] = (request, outbox)  # lint: single-writer — each handler owns its follower key
         try:
             yield averaging_pb2.MessageFromLeader(code=averaging_pb2.ACCEPTED)
             if (
@@ -464,7 +459,7 @@ class Matchmaking:
         )
         for _request, outbox in self.current_followers.values():
             outbox.put_nowait(message)
-        asyncio.ensure_future(self.key_manager.update_key_on_group_assembled(group))
+        spawn(self.key_manager.update_key_on_group_assembled(group), name="matchmaking.update_key_on_group_assembled")
         logger.debug(f"assembled group of {len(members)} (leader={self.peer_id})")
         return group
 

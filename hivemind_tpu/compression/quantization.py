@@ -5,7 +5,7 @@ ISSUE 11 rework: these codecs are now on the averaging WIRE hot path (the
 butterfly all-reduce's reduce-scatter and all-gather legs run them per part in
 the shared executor), so the compress/extract paths are pure numpy — no jit
 dispatch, no host↔device hop — and copy-discipline matches the Float16 path
-from ISSUE 6/10 (this file is covered by ``tools/check_hotpath_copies.py``):
+from ISSUE 6/10 (this file is covered by hivemind-lint's ``hotpath-copies`` rule):
 
 - code assignment runs CHUNKED through one small reusable float scratch, so
   neither ``compress`` path materializes an input-sized temporary — the codecs

@@ -34,6 +34,7 @@ from hivemind_tpu.dht.validation import DHTRecord, RecordValidatorBase
 from hivemind_tpu.p2p import Multiaddr, P2P, PeerID
 from hivemind_tpu.resilience import BreakerBoard, Deadline
 from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.asyncio_utils import spawn
 from hivemind_tpu.utils.serializer import MSGPackSerializer
 from hivemind_tpu.utils.timed_storage import (
     DHTExpiration,
@@ -163,6 +164,9 @@ class DHTNode:
         self._cache_refresh_queue = TimedStorage[DHTID, DHTExpiration]()
         self._cache_refresh_available = asyncio.Event()
         self._refresh_task: Optional[asyncio.Task] = None
+        # post-response background work (cache_nearest replication) that must
+        # not outlive the node: cancelled in shutdown()
+        self._background: set = set()
         self._owns_p2p = p2p is None
 
         if p2p is None:
@@ -594,7 +598,9 @@ class DHTNode:
                     )
                 )
                 # caching policies need the traversal results
-                asyncio.create_task(self._apply_caching_policies(traverse_task, unfinished, search_results, node_to_peer))
+                caching_task = spawn(self._apply_caching_policies(traverse_task, unfinished, search_results, node_to_peer), name="dht.apply_caching_policies")
+                self._background.add(caching_task)
+                caching_task.add_done_callback(self._background.discard)
             else:
                 for key_id in unfinished:
                     self._finalize_get(key_id, search_results[key_id], futures[key_id], _is_refresh)
@@ -709,7 +715,7 @@ class DHTNode:
 
     def _schedule_cache_refresh(self, key_id: DHTID, expiration_time: DHTExpiration) -> None:
         if self._refresh_task is None or self._refresh_task.done():
-            self._refresh_task = asyncio.create_task(self._refresh_stale_cache_entries())
+            self._refresh_task = spawn(self._refresh_stale_cache_entries(), name="dht.cache_refresh")
         refresh_time = expiration_time - self.cache_refresh_before_expiry
         self._cache_refresh_queue.store(key_id, expiration_time, refresh_time)
         self._cache_refresh_available.set()
@@ -719,7 +725,7 @@ class DHTNode:
         (reference node.py:727-761)."""
         while True:
             while not self._cache_refresh_queue:
-                self._cache_refresh_available.clear()
+                self._cache_refresh_available.clear()  # lint: single-writer — sole refresh task
                 await self._cache_refresh_available.wait()
             entry = self._cache_refresh_queue.top()
             if entry is None:
@@ -734,7 +740,7 @@ class DHTNode:
                 except asyncio.TimeoutError:
                     pass
             if key_id in self._cache_refresh_queue:
-                del self._cache_refresh_queue[key_id]
+                del self._cache_refresh_queue[key_id]  # lint: single-writer — sole refresh task
             if key_id not in self.protocol.cache:
                 continue
             await self.get_many_by_id(
@@ -750,6 +756,8 @@ class DHTNode:
     async def shutdown(self) -> None:
         if self._refresh_task is not None:
             self._refresh_task.cancel()
+        for task in list(self._background):
+            task.cancel()
         await self.protocol.shutdown()
         if self._owns_p2p:
             await self.p2p.shutdown()

@@ -20,6 +20,7 @@ from hivemind_tpu.p2p import P2P, P2PContext, P2PError, PeerID, ServicerBase
 from hivemind_tpu.proto import dht_pb2
 from hivemind_tpu.resilience import CHAOS as _CHAOS
 from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.asyncio_utils import spawn
 from hivemind_tpu.utils.serializer import MSGPackSerializer
 from hivemind_tpu.utils.timed_storage import (
     MAX_DHT_TIME_DISCREPANCY_SECONDS,
@@ -352,11 +353,11 @@ class DHTProtocol(ServicerBase):
         _DHT_ROUTING_TABLE_SIZE.set(len(self.routing_table))
         if ping_candidate is not None:
             # bucket full: ping the stalest entry; evict it if dead (Kademlia §4.1)
-            task = asyncio.create_task(self._check_stale_node(*ping_candidate))
+            task = spawn(self._check_stale_node(*ping_candidate), name="dht.check_stale_node")
             self._handoff_tasks.add(task)
             task.add_done_callback(self._handoff_tasks.discard)
         if is_new and node_id in self.routing_table and self.storage:
-            task = asyncio.create_task(self._handoff_keys(node_id))
+            task = spawn(self._handoff_keys(node_id), name="dht.handoff_keys")
             self._handoff_tasks.add(task)
             task.add_done_callback(self._handoff_tasks.discard)
 

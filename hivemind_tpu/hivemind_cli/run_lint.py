@@ -1,0 +1,38 @@
+"""Console entry point for ``hivemind-lint`` (ISSUE 16).
+
+The suite itself lives in ``tools/lint`` — deliberately outside the installed
+package, next to the allowlists and fixtures it reads, so linting the repo
+never imports (or depends on importing) jax or the runtime. This wrapper just
+puts ``tools/`` on ``sys.path`` and delegates; it exists so pyproject.toml can
+register a ``hivemind-lint`` script.
+
+Keep this module import-light: it must work in environments where the heavy
+runtime deps are absent.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def main() -> int:
+    tools_dir = _REPO_ROOT / "tools"
+    if not (tools_dir / "lint" / "engine.py").is_file():
+        print(
+            "hivemind-lint: tools/lint not found — the lint suite only runs from a "
+            "source checkout (it reads allowlists and fixtures next to the code)",
+            file=sys.stderr,
+        )
+        return 2
+    if str(tools_dir) not in sys.path:
+        sys.path.insert(0, str(tools_dir))
+    from lint.cli import main as lint_main
+
+    return lint_main()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -24,6 +24,7 @@ uid-level breaker keeps its PR 8 semantics (it trips only when the whole call
 from __future__ import annotations
 
 import asyncio
+import os
 import random
 import threading
 import time
@@ -54,6 +55,7 @@ from hivemind_tpu.telemetry.serving import (
     WIRE_BYTES_SENT,
     is_overload_error,
 )
+from hivemind_tpu.utils.asyncio_utils import aiter_with_timeout
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.loop import LoopRunner, get_loop_runner
 from hivemind_tpu.utils.serializer import MSGPackSerializer
@@ -62,6 +64,11 @@ logger = get_logger(__name__)
 
 MAX_UNARY_PAYLOAD_SIZE = 2 * 1024 * 1024  # parity: p2p_daemon_bindings/control.py:36-39
 _OFF_LOOP_CODEC_BYTES = 256 * 1024  # payloads past this compress/decompress in the executor
+# hard ceiling on a single expert RPC (unary round-trip / per streamed message)
+# and on the info fetch: a server that stalls mid-call must surface as a replica
+# failure the hedging/breaker layer can act on, not wedge the caller forever
+EXPERT_RPC_TIMEOUT = float(os.getenv("HIVEMIND_TPU_EXPERT_RPC_TIMEOUT", "120"))
+_INFO_RPC_TIMEOUT = 10.0
 
 # serving wire accounting, this process as the CALLER (docs/observability.md)
 _CLIENT_BYTES_SENT = WIRE_BYTES_SENT.labels("client")
@@ -216,12 +223,15 @@ class RemoteExpert:
         last_error: Optional[BaseException] = None
         for replica in (self._replica_order() or list(self.replicas)):
             try:
-                response = await self.p2p.call_protobuf_handler(
-                    replica.peer_id,
-                    "ConnectionHandler.rpc_info",
-                    runtime_pb2.ExpertUID(uid=self.uid),
-                    runtime_pb2.ExpertInfoResponse,
-                    idempotent=True,
+                response = await asyncio.wait_for(
+                    self.p2p.call_protobuf_handler(
+                        replica.peer_id,
+                        "ConnectionHandler.rpc_info",
+                        runtime_pb2.ExpertUID(uid=self.uid),
+                        runtime_pb2.ExpertInfoResponse,
+                        idempotent=True,
+                    ),
+                    timeout=_INFO_RPC_TIMEOUT,
                 )
                 break
             except Exception as e:
@@ -543,12 +553,15 @@ class RemoteExpert:
             # spliced scatter-gather request: tensor buffers ride to the AEAD
             # uncopied instead of being re-materialized by SerializeToString
             request = expert_request_parts(self.uid, serialized, metadata)
-            response = await self.p2p.call_protobuf_handler(
-                target_peer,
-                f"ConnectionHandler.rpc_{method}",
-                request,
-                runtime_pb2.ExpertResponse,
-                idempotent=(f"rpc_{method}" in IDEMPOTENT_CONNECTION_RPCS),
+            response = await asyncio.wait_for(
+                self.p2p.call_protobuf_handler(
+                    target_peer,
+                    f"ConnectionHandler.rpc_{method}",
+                    request,
+                    runtime_pb2.ExpertResponse,
+                    idempotent=(f"rpc_{method}" in IDEMPOTENT_CONNECTION_RPCS),
+                ),
+                timeout=EXPERT_RPC_TIMEOUT,
             )
             # counted AFTER the round-trip: a shed/dead-peer attempt must not
             # drift client-sent above server-received (retries count once, like
@@ -584,7 +597,9 @@ class RemoteExpert:
         )
 
         async def parts():
-            async for response in stream:
+            # per-message deadline: total transfer time is unbounded, but any
+            # single inter-message stall past the RPC timeout fails the replica
+            async for response in aiter_with_timeout(stream, EXPERT_RPC_TIMEOUT):
                 _CLIENT_BYTES_RECEIVED.inc(response.ByteSize())
                 yield list(response.tensors)
 

@@ -13,6 +13,7 @@ from typing import Dict
 
 from hivemind_tpu.moe.server.module_backend import ModuleBackend
 from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.asyncio_utils import spawn
 
 logger = get_logger(__name__)
 
@@ -55,7 +56,7 @@ class CheckpointSaver:
         self._task = None
 
     def start(self) -> None:
-        self._task = asyncio.create_task(self._loop())
+        self._task = spawn(self._loop(), name="checkpoints.loop")
 
     async def _loop(self) -> None:
         from hivemind_tpu.utils.asyncio_utils import run_in_executor

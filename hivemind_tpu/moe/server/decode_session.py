@@ -41,6 +41,7 @@ import numpy as np
 
 from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
 from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.asyncio_utils import spawn
 
 logger = get_logger(__name__)
 
@@ -240,6 +241,12 @@ class DecodeSessionManager:
                 session.cache_v, jnp.int32(session.index),
             )
             session.index += new_len
+            # re-stamp AFTER the device step: a step that hits a jit compile can
+            # outlast merge_recency_s, and a session stamped only at entry would
+            # look stale to _concurrent_sessions the instant its own prefill
+            # returns — so two freshly-prefilled streams never engage batching.
+            # Bare float store; concurrent readers just see one of two recent stamps.
+            session.last_used = time.monotonic()
             _STEPS.inc(path="direct")
             return np.asarray(y)[:, :new_len]
 
@@ -281,7 +288,7 @@ class DecodeSessionManager:
             session.last_used = time.monotonic()
             self._pending.setdefault(uid, []).append((future, session, x))
             if uid not in self._drainers or self._drainers[uid].done():
-                self._drainers[uid] = loop.create_task(self._drain(uid))
+                self._drainers[uid] = spawn(self._drain(uid), name="decode_session.drain")
         return await future
 
     # NOTE on merge_recency_s (set in __init__; HIVEMIND_TPU_MERGE_RECENCY_S):
@@ -370,7 +377,7 @@ class DecodeSessionManager:
                 if rollover:
                     self._pending.setdefault(uid, []).extend(rollover)
                 if self._pending.get(uid):
-                    self._drainers[uid] = loop.create_task(self._drain(uid))
+                    self._drainers[uid] = spawn(self._drain(uid), name="decode_session.drain")
         except asyncio.CancelledError:
             # drainer killed mid-batch (loop shutdown, server stop): nothing will
             # ever resolve these futures or re-drain the rollover — cancel them so

@@ -37,7 +37,7 @@ from hivemind_tpu.moe.expert_uid import UID_DELIMITER
 from hivemind_tpu.proto import runtime_pb2
 from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
 from hivemind_tpu.telemetry.serving import SERVING_LEDGER
-from hivemind_tpu.utils.asyncio_utils import run_in_executor
+from hivemind_tpu.utils.asyncio_utils import aiter_with_timeout, run_in_executor, spawn
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.serializer import MSGPackSerializer
 from hivemind_tpu.utils.timed_storage import get_dht_time
@@ -118,7 +118,9 @@ async def fetch_replica_state(p2p, source_peer_id, uid: str, chunk_timeout: floa
     )
     meta: Optional[Dict] = None
     chunks: List[bytes] = []
-    async for message in stream:
+    # chunk_timeout bounds each INTER-CHUNK gap (a stalled donor must fail the
+    # fetch, not wedge it forever) while leaving total transfer time unbounded
+    async for message in aiter_with_timeout(stream, chunk_timeout):
         if meta is None:
             meta = MSGPackSerializer.loads(message.metadata)
             continue
@@ -161,7 +163,7 @@ class ReplicationManager:
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> None:
-        self._task = asyncio.create_task(self._loop())
+        self._task = spawn(self._loop(), name="replication.loop")
 
     def shutdown(self) -> None:
         if self._task is not None:
@@ -308,7 +310,7 @@ class ReplicationManager:
                 logger.warning(f"could not acquire replica of {uid!r} from {source_peer}: {e!r}")
                 continue
             await self.server.add_backend(uid, backend)
-            self.acquired.append(uid)
+            self.acquired.append(uid)  # lint: single-writer — only the replication loop appends
             _ACQUIRED.inc()
             logger.info(
                 f"acquired replica of {uid!r} from {source_peer} "

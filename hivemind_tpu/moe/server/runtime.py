@@ -12,6 +12,7 @@ from typing import Dict, Optional, Sequence, Tuple
 from hivemind_tpu.moe.server.task_pool import TaskPool
 from hivemind_tpu.utils.asyncio_utils import run_in_executor
 from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.asyncio_utils import spawn
 
 logger = get_logger(__name__)
 
@@ -75,7 +76,7 @@ class Runtime:
         }
 
     def start(self) -> None:
-        self._task = asyncio.create_task(self._run())
+        self._task = spawn(self._run(), name="runtime.run")
 
     def add_pool(self, pool: TaskPool) -> None:
         """Register a pool created after start() (ISSUE 13 expert replication:
@@ -100,7 +101,7 @@ class Runtime:
         while True:
             if not self.pools:
                 # a replica-slot server starts empty and gains pools at runtime
-                self._pools_changed.clear()
+                self._pools_changed.clear()  # lint: single-writer — loop clears its own wake event
                 await self._pools_changed.wait()
                 continue
             self._pools_changed.clear()

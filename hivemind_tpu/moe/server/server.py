@@ -24,6 +24,7 @@ from hivemind_tpu.moe.server.layers import name_to_block, name_to_input
 from hivemind_tpu.moe.server.module_backend import ModuleBackend
 from hivemind_tpu.moe.server.runtime import Runtime
 from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.asyncio_utils import spawn
 from hivemind_tpu.utils.loop import LoopRunner, get_loop_runner
 from hivemind_tpu.utils.timed_storage import get_dht_time
 
@@ -178,7 +179,7 @@ class Server:
             self.checkpoint_saver.start()
         if self.replication is not None:
             self.replication.start()
-        self._declare_task = asyncio.create_task(self._declare_periodically())
+        self._declare_task = spawn(self._declare_periodically(), name="server.declare_periodically")
         self._ready.set()
 
     async def add_backend(self, uid: str, backend: ModuleBackend) -> None:

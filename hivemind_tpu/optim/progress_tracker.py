@@ -20,6 +20,7 @@ from hivemind_tpu.dht.crypto import Ed25519SignatureValidator
 from hivemind_tpu.dht.schema import BytesWithEd25519PublicKey, SchemaValidator
 from hivemind_tpu.utils.crypto import Ed25519PrivateKey
 from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.asyncio_utils import spawn
 from hivemind_tpu.utils.loop import LoopRunner, get_loop_runner
 from hivemind_tpu.utils.performance_ema import PerformanceEMA
 from hivemind_tpu.utils.timed_storage import DHTExpiration, get_dht_time
@@ -165,8 +166,8 @@ class ProgressTracker:
     async def _start_tasks(self) -> None:
         self._report_event = asyncio.Event()
         self._fetch_soon = asyncio.Event()
-        self._reporter_task = asyncio.create_task(self._reporter())
-        self._fetcher_task = asyncio.create_task(self._fetcher())
+        self._reporter_task = spawn(self._reporter(), name="progress_tracker.reporter")
+        self._fetcher_task = spawn(self._fetcher(), name="progress_tracker.fetcher")
 
     # ------------------------------------------------------------------ local side
 
@@ -254,7 +255,7 @@ class ProgressTracker:
         while not self.shutdown_requested:
             # clear BEFORE snapshotting: an update arriving mid-store must survive
             # into the next iteration, not be silently dropped
-            self._report_event.clear()
+            self._report_event.clear()  # lint: single-writer — reporter clears its own wake event
             with contextlib.suppress(Exception):
                 with self._lock:
                     record = self.local_progress
@@ -275,7 +276,7 @@ class ProgressTracker:
             wait_time = max(0.0, self.global_progress.next_fetch_time - get_dht_time())
             with contextlib.suppress(asyncio.TimeoutError):
                 await asyncio.wait_for(self._fetch_soon.wait(), timeout=wait_time)
-            self._fetch_soon.clear()
+            self._fetch_soon.clear()  # lint: single-writer — fetcher clears its own wake event
             with contextlib.suppress(Exception):
                 await self._fetch_global_progress()
 

@@ -36,6 +36,7 @@ from hivemind_tpu.p2p.nat import NATTraversal
 from hivemind_tpu.p2p.peer_id import PeerID
 from hivemind_tpu.p2p.relay import RelayClient
 from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.asyncio_utils import spawn
 
 logger = get_logger(__name__)
 
@@ -129,7 +130,7 @@ class AutoRelay:
             await self._ensure_registrations()
             if not self.relay_clients:
                 logger.warning("NATed but no advertised relay accepted registration")
-        self._maintenance_task = asyncio.create_task(self._maintenance_loop())
+        self._maintenance_task = spawn(self._maintenance_loop(), name="autorelay.maintenance_loop")
         return self
 
     # ------------------------------------------------------------------ diagnosis
@@ -174,7 +175,7 @@ class AutoRelay:
                     # without is only accepted under the explicit opt-out
                     allow_plaintext=self.allow_plaintext and not pubkey_hex,
                 )
-                self.relay_clients[(host, port)] = client
+                self.relay_clients[(host, port)] = client  # lint: single-writer — maintenance loop only
             except Exception as e:
                 logger.debug(f"auto-relay registration at {host}:{port} failed: {e!r}")
         if self.relay_clients:
@@ -226,8 +227,8 @@ class AutoRelay:
                 if conn is not None and not conn.is_closed:
                     # opportunistic DCUtR upgrade: swap endpoints through the fresh
                     # relayed path and race direct dials; failure keeps the circuit
-                    task = asyncio.create_task(self._try_upgrade(peer_id))
-                    self._bg_tasks.add(task)
+                    task = spawn(self._try_upgrade(peer_id), name="autorelay.try_upgrade")
+                    self._bg_tasks.add(task)  # lint: single-writer — add/discard are idempotent
                     task.add_done_callback(self._bg_tasks.discard)
                     return conn
             except Exception as e:
@@ -256,7 +257,7 @@ class AutoRelay:
                 if client._control_task is None or client._control_task.done()
             ]
             for key in dead:
-                client = self.relay_clients.pop(key)
+                client = self.relay_clients.pop(key)  # lint: single-writer — maintenance loop only
                 await client.close()
             await self._ensure_registrations()
 

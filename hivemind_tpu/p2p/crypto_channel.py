@@ -55,6 +55,7 @@ except ImportError:  # no cryptography wheel on this image: system libcrypto shi
 
 from hivemind_tpu.utils.crypto import Ed25519PrivateKey, Ed25519PublicKey
 from hivemind_tpu.utils.serializer import MSGPackSerializer
+from hivemind_tpu.utils.asyncio_utils import spawn
 
 MAX_FRAME_SIZE = 16 * 1024 * 1024  # hard cap on one encrypted frame
 _HANDSHAKE_PREFIX = b"hivemind-tpu-noise-v1:"
@@ -84,7 +85,9 @@ def _get_aead_executor() -> Optional[ThreadPoolExecutor]:
     if _aead_executor is None or _aead_executor._max_workers != workers:
         if _aead_executor is not None:
             _aead_executor.shutdown(wait=False)
-        _aead_executor = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="hm_aead")
+        # hmtpu- prefix: the test thread sanitizer exempts the shared
+        # process-lifetime executors by this naming convention
+        _aead_executor = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="hmtpu-aead")
     return _aead_executor
 
 
@@ -153,7 +156,7 @@ class SecureChannel:
         else:
             sealed = self._seal(nonce, payload, extra_buffers)
         if self._writer_task is None:
-            self._writer_task = asyncio.create_task(self._writer_loop())
+            self._writer_task = spawn(self._writer_loop(), name="crypto_channel.writer_loop")
         self._send_queue.put_nowait(sealed)
 
     def _seal(self, nonce: bytes, payload: bytes, extra_buffers: Tuple[bytes, ...]) -> bytes:
@@ -207,7 +210,7 @@ class SecureChannel:
 
     async def recv(self) -> bytes:
         if self._reader_task is None:
-            self._reader_task = asyncio.create_task(self._reader_loop())
+            self._reader_task = spawn(self._reader_loop(), name="crypto_channel.reader_loop")
         while True:
             if self._recv_stopped or (self._recv_error is not None and self._recv_queue.empty()):
                 raise self._recv_error
@@ -216,7 +219,7 @@ class SecureChannel:
                 # one sentinel must serve EVERY concurrent recv(): re-enqueue it so
                 # a second parked waiter wakes and raises too instead of hanging
                 with contextlib.suppress(asyncio.QueueFull):
-                    self._recv_queue.put_nowait(None)
+                    self._recv_queue.put_nowait(None)  # lint: single-writer — sentinel re-enqueue is idempotent
                 if self._recv_error is not None:
                     raise self._recv_error
                 continue
@@ -269,7 +272,7 @@ class SecureChannel:
                     raise HandshakeError(f"oversized frame: {length}")
                 ciphertext = await self._reader.readexactly(length)
                 nonce = struct.pack("<4xQ", self._recv_counter)
-                self._recv_counter += 1
+                self._recv_counter += 1  # lint: single-writer — sole reader loop owns the nonce
                 executor = _get_aead_executor()
                 if executor is not None and length >= _OFFLOAD_THRESHOLD:
                     # wrap the executor future so an InvalidTag poisons the channel
@@ -440,4 +443,10 @@ async def handshake(
             raise
         return channel, {"addrs": peer_hello.get("addrs", []), "static": peer_hello["static"]}
 
-    return await asyncio.wait_for(_run(), timeout=timeout)
+    try:
+        return await asyncio.wait_for(_run(), timeout=timeout)
+    except (ValueError, KeyError, TypeError, IndexError, struct.error) as e:
+        # a malformed/hostile hello (bad msgpack, wrong shapes, junk key bytes)
+        # must read as a handshake failure the acceptor already handles — not
+        # crash the per-connection task with an unretrieved msgpack error
+        raise HandshakeError(f"malformed handshake from peer: {e!r}") from e

@@ -34,6 +34,7 @@ Message = Union[bytes, memoryview]
 from hivemind_tpu.p2p.crypto_channel import SecureChannel
 from hivemind_tpu.telemetry.tracing import unpack_context
 from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.asyncio_utils import spawn
 from hivemind_tpu.utils.serializer import MSGPackSerializer
 
 logger = get_logger(__name__)
@@ -206,7 +207,7 @@ class MuxConnection:
         self._buffered_bytes -= nbytes
 
     def start(self) -> None:
-        self._read_task = asyncio.create_task(self._read_loop())
+        self._read_task = spawn(self._read_loop(), name="mux.read_loop")
 
     @property
     def is_closed(self) -> bool:
@@ -288,7 +289,7 @@ class MuxConnection:
             if trace_raw:
                 stream.trace_context = unpack_context(trace_raw)
             self._streams[stream_id] = stream
-            task = asyncio.create_task(self._on_inbound_stream(stream))
+            task = spawn(self._on_inbound_stream(stream), name="mux.inbound_stream")
             self._handler_tasks.add(task)
             self._stream_handler_tasks[stream_id] = task
 

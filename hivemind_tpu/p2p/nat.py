@@ -27,12 +27,16 @@ from typing import List, Optional, Sequence
 
 from hivemind_tpu.p2p.peer_id import Multiaddr, PeerID
 from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.asyncio_utils import spawn
 from hivemind_tpu.utils.serializer import MSGPackSerializer
 
 logger = get_logger(__name__)
 
 MAX_PROBE_ADDRS = 4
 PROBE_TIMEOUT = 3.0
+# control RPCs ride a (possibly relayed) path to a peer that then dials N
+# addresses at PROBE_TIMEOUT each — generous, but never infinite
+CONTROL_RPC_TIMEOUT = 15.0
 PUNCH_TIMEOUT = 10.0
 
 
@@ -41,7 +45,6 @@ class NATTraversal:
 
     def __init__(self, p2p):
         self.p2p = p2p
-        self._punch_tasks: set = set()  # strong refs: the loop holds tasks weakly
 
     async def register_handlers(self) -> None:
         await self.p2p.add_protobuf_handler("nat.check", self._rpc_check)
@@ -75,7 +78,10 @@ class NATTraversal:
         register at a relay (reference auto_relay, p2p_daemon.py:126-137)."""
         maddrs = maddrs if maddrs is not None else self.p2p.get_visible_maddrs()
         request = MSGPackSerializer.dumps([str(m) for m in maddrs])
-        response = await self.p2p.call_protobuf_handler(via, "nat.check", request, idempotent=True)
+        response = await asyncio.wait_for(
+            self.p2p.call_protobuf_handler(via, "nat.check", request, idempotent=True),
+            timeout=CONTROL_RPC_TIMEOUT,
+        )
         return list(MSGPackSerializer.loads(response))
 
     # ------------------------------------------------------------------ hole punching
@@ -84,9 +90,7 @@ class NATTraversal:
         """The passive side: reply with our direct endpoints and immediately start
         dialing the initiator's (TCP simultaneous open under real NATs)."""
         their_addrs = [Multiaddr.parse(a) for a in MSGPackSerializer.loads(request)]
-        task = asyncio.create_task(self._punch_dial(context.remote_id, their_addrs))
-        self._punch_tasks.add(task)
-        task.add_done_callback(self._punch_tasks.discard)
+        spawn(self._punch_dial(context.remote_id, their_addrs), name="nat.punch_dial")
         return MSGPackSerializer.dumps([str(m) for m in self.p2p.get_visible_maddrs()])
 
     async def _punch_dial(self, peer_id: PeerID, addrs: Sequence[Multiaddr]) -> bool:
@@ -111,6 +115,9 @@ class NATTraversal:
         # punch is effectively idempotent (the handler's dial uses replace_existing),
         # so the ambiguous-loss retry is safe — and this call races connection churn
         # by construction
-        response = await self.p2p.call_protobuf_handler(peer_id, "nat.punch", request, idempotent=True)
+        response = await asyncio.wait_for(
+            self.p2p.call_protobuf_handler(peer_id, "nat.punch", request, idempotent=True),
+            timeout=CONTROL_RPC_TIMEOUT,
+        )
         their_addrs = [Multiaddr.parse(a) for a in MSGPackSerializer.loads(response)]
         return await self._punch_dial(peer_id, their_addrs)

@@ -45,6 +45,7 @@ from hivemind_tpu.resilience import CHAOS as _CHAOS
 from hivemind_tpu.resilience import Deadline
 from hivemind_tpu.utils.crypto import Ed25519PrivateKey
 from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.asyncio_utils import spawn
 from hivemind_tpu.utils.streaming import WireParts
 
 logger = get_logger(__name__)
@@ -309,7 +310,7 @@ class P2P:
                 pubkey, _, hostport = relay_spec.rpartition("@")
                 relay_host, _, relay_port = hostport.rpartition(":")
                 try:
-                    self._relays.append(
+                    self._relays.append(  # lint: single-writer — create() runs once
                         await RelayClient.create(
                             self, relay_host, int(relay_port), relay_pubkey=pubkey or None
                         )
@@ -452,7 +453,7 @@ class P2P:
                 # the daemon ties the public listener to this conn: watch it —
                 # a daemon crash otherwise leaves us announcing a dead port
                 # forever while outbound dials keep working and mask the loss
-                watchdog = asyncio.create_task(self._watch_inbound_proxy(reader))
+                watchdog = spawn(self._watch_inbound_proxy(reader), name="p2p.inbound_proxy_watchdog")
                 self._bg_tasks.add(watchdog)
                 watchdog.add_done_callback(self._bg_tasks.discard)
                 return struct.unpack(">H", response[1:3])[0]
@@ -563,9 +564,9 @@ class P2P:
             if len(self._all_connections) <= low_water:
                 break
             await conn.close()
-            self._all_connections.discard(conn)
+            self._all_connections.discard(conn)  # lint: single-writer — guarded `is conn` del + idempotent discard
             if self._connections.get(conn.peer_id) is conn:
-                del self._connections[conn.peer_id]
+                del self._connections[conn.peer_id]  # lint: single-writer — guarded `is conn` del + idempotent discard
 
     def _register_peer_addrs(self, peer_id: PeerID, addrs) -> None:
         store = self._peerstore.setdefault(peer_id, set())
@@ -693,7 +694,7 @@ class P2P:
             await asyncio.sleep(grace)
             await conn.close()
 
-        task = asyncio.create_task(_close())
+        task = spawn(_close(), name="p2p.close_after_grace")
         self._bg_tasks.add(task)
         task.add_done_callback(self._bg_tasks.discard)
 
@@ -1030,7 +1031,7 @@ class P2P:
         while self._all_connections:
             for conn in list(self._all_connections):
                 await conn.close()
-                self._all_connections.discard(conn)
+                self._all_connections.discard(conn)  # lint: single-writer — shutdown runs once
         self._connections.clear()
         try:
             # py3.12 wait_closed waits for every server-spawned transport; a peer

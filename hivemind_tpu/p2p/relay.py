@@ -45,6 +45,7 @@ from hivemind_tpu.p2p.crypto_channel import handshake
 from hivemind_tpu.p2p.mux import MuxConnection
 from hivemind_tpu.p2p.peer_id import PeerID
 from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.asyncio_utils import spawn
 
 logger = get_logger(__name__)
 
@@ -241,7 +242,7 @@ class RelayClient:
         if response != b"O":
             raise ConnectionError(f"relay refused registration: {response!r}")
         self._control = channel
-        self._control_task = asyncio.create_task(self._control_loop(channel))
+        self._control_task = spawn(self._control_loop(channel), name="relay.control_loop")
         mode = "encrypted" if channel.encrypted else "plaintext"
         logger.info(
             f"registered at relay {self.host}:{self.port} as {self.p2p.peer_id} ({mode} control)"
@@ -254,7 +255,7 @@ class RelayClient:
                 frame = await channel.recv_frame()
                 if frame[:1] == b"I" and len(frame) >= 17:
                     token = frame[1:17]
-                    asyncio.create_task(self._accept(token))
+                    spawn(self._accept(token), name="relay.accept")
         except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
             logger.warning(f"relay control line lost: {e!r}")
 

@@ -9,6 +9,7 @@ from hivemind_tpu.utils.asyncio_utils import (
     azip,
     cancel_and_wait,
     enter_asynchronously,
+    spawn,
     switch_to_uvloop,
 )
 from hivemind_tpu.utils.logging import get_logger

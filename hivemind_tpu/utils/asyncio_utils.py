@@ -116,6 +116,64 @@ async def amap_in_executor(
             producer.cancel()
 
 
+# strong refs: asyncio keeps only a weak reference to running tasks, so a spawned
+# task with no other referent is garbage-collectable MID-FLIGHT
+_background_tasks: set = set()
+_background_error_counter = None
+
+
+def _count_background_error(site: str) -> None:
+    global _background_error_counter
+    if _background_error_counter is None:
+        # lazy: telemetry's package init pulls in monitor/exporter, which must not
+        # become an import-time dependency of the utils layer
+        from hivemind_tpu.telemetry.registry import REGISTRY
+
+        _background_error_counter = REGISTRY.counter(
+            "hivemind_background_task_errors_total",
+            "exceptions raised by fire-and-forget background tasks, by spawn site",
+            ("site",),
+        )
+    _background_error_counter.inc(site=site)
+
+
+def _on_background_done(name: str, task: asyncio.Task) -> None:
+    _background_tasks.discard(task)
+    if task.cancelled():
+        return
+    exc = task.exception()  # marks the exception retrieved either way
+    if exc is None:
+        return
+    from hivemind_tpu.utils.logging import get_logger
+
+    get_logger(__name__).warning(f"background task {name!r} failed: {exc!r}")
+    try:
+        _count_background_error(name)
+    except Exception:  # lint: allow(adhoc-retries) — counting must never mask the original failure
+        pass
+
+
+def spawn(coro: Awaitable, *, name: str) -> asyncio.Task:
+    """Tracked fire-and-forget: the approved alternative to a bare
+    ``asyncio.create_task(...)`` whose handle is dropped (flagged by the
+    ``fire-and-forget`` lint rule).
+
+    Keeps a strong reference until the task finishes, names the task, and on
+    failure logs + increments ``hivemind_background_task_errors_total{site}``
+    instead of letting the exception rot until interpreter shutdown. The
+    returned task may still be stored/awaited/cancelled by the caller —
+    retrieving the exception here does not stop a later ``await task`` from
+    re-raising it."""
+    task = asyncio.ensure_future(coro)
+    try:
+        task.set_name(name)
+    except AttributeError:
+        pass  # lint: allow(adhoc-retries) — futures (vs tasks) have no set_name; name only aids debugging
+    _background_tasks.add(task)
+    task.add_done_callback(lambda t, _name=name: _on_background_done(_name, t))
+    return task
+
+
 async def cancel_and_wait(task: asyncio.Task) -> bool:
     """Cancel a task and wait until the cancellation lands. Returns True if it was
     cancelled (vs finished/failed first)."""

@@ -22,26 +22,88 @@ jax.devices()
 import asyncio  # noqa: E402
 import gc  # noqa: E402
 import inspect  # noqa: E402
+import threading  # noqa: E402
+import time  # noqa: E402
 
 import pytest  # noqa: E402
 
 
+def _run_async_test(func, kwargs, allow_task_leaks: bool) -> None:
+    """asyncio sanitizer (ISSUE 16 satellite): run the test on a fresh loop and
+    fail it when it leaks pending tasks or lets a task exception rot
+    unretrieved — the runtime twin of the ``fire-and-forget`` lint rule.
+    Opt out with ``@pytest.mark.allow_task_leaks`` (e.g. for tests that
+    deliberately abandon a wedged peer)."""
+    unhandled = []
+    leaked = []
+    loop = asyncio.new_event_loop()
+    loop.set_exception_handler(lambda _loop, context: unhandled.append(context))
+    asyncio.set_event_loop(loop)
+
+    async def _main():
+        try:
+            await func(**kwargs)
+        finally:
+            current = asyncio.current_task()
+            leaked.extend(
+                task for task in asyncio.all_tasks() if task is not current and not task.done()
+            )
+            for task in leaked:
+                task.cancel()
+            if leaked:
+                # reap them even when allowed, so nothing pollutes the next test
+                await asyncio.wait(leaked, timeout=3.0)
+
+    try:
+        loop.run_until_complete(_main())
+        loop.run_until_complete(loop.shutdown_asyncgens())
+        loop.run_until_complete(loop.shutdown_default_executor())
+    finally:
+        asyncio.set_event_loop(None)
+        loop.close()
+    # a failed task that was never awaited reports "exception was never
+    # retrieved" via the loop exception handler from Task.__del__ — force it now
+    del func, kwargs
+    gc.collect()
+
+    if allow_task_leaks:
+        return
+    problems = []
+    if leaked:
+        names = sorted(task.get_name() for task in leaked)
+        problems.append(
+            f"test left {len(leaked)} pending task(s) on the loop: {names} — "
+            f"await/cancel them (or mark the test @pytest.mark.allow_task_leaks)"
+        )
+    for context in unhandled:
+        message = context.get("message", "")
+        exception = context.get("exception")
+        problems.append(
+            f"unhandled asyncio error: {message or 'exception'}: {exception!r} "
+            f"(task={context.get('task') or context.get('future')})"
+        )
+    if problems:
+        pytest.fail("asyncio sanitizer: " + "\n".join(problems))
+
+
 def pytest_pyfunc_call(pyfuncitem):
     """Native asyncio test support (pytest-asyncio is not installed on this image):
-    `async def` tests run under asyncio.run with a fresh loop."""
+    `async def` tests run on a fresh sanitized loop."""
     if inspect.iscoroutinefunction(pyfuncitem.obj):
         kwargs = {
             name: pyfuncitem.funcargs[name]
             for name in pyfuncitem._fixtureinfo.argnames
         }
-        asyncio.run(pyfuncitem.obj(**kwargs))
+        allow = pyfuncitem.get_closest_marker("allow_task_leaks") is not None
+        _run_async_test(pyfuncitem.obj, kwargs, allow)
         return True
     return None
 
 
 @pytest.fixture(autouse=True)
-def cleanup_children():
+def cleanup_children(request):
     """Reset process-wide singletons between tests (reference tests/conftest.py:14-33)."""
+    thread_baseline = {thread.ident for thread in threading.enumerate()}
     yield
     import os
 
@@ -62,6 +124,33 @@ def cleanup_children():
     telemetry_watchdog.shutdown_all()  # watchdog threads re-arm with the next loop owner
     Ed25519PrivateKey.reset_process_wide()
     gc.collect()
+
+    # thread sanitizer (ISSUE 16 satellite): a test must not strand non-daemon
+    # threads — they outlive the suite and wedge interpreter shutdown. The
+    # shared hmtpu-* executors are process-lifetime infrastructure by design.
+    if request.node.get_closest_marker("allow_thread_leaks") is None:
+
+        def _stragglers():
+            return [
+                thread
+                for thread in threading.enumerate()
+                if thread.ident not in thread_baseline
+                and thread.is_alive()
+                and not thread.daemon
+                and not thread.name.startswith("hmtpu-")
+            ]
+
+        deadline = time.monotonic() + 3.0
+        leaked_threads = _stragglers()
+        while leaked_threads and time.monotonic() < deadline:
+            time.sleep(0.05)  # teardown joins may still be in flight
+            leaked_threads = _stragglers()
+        if leaked_threads:
+            pytest.fail(
+                "thread sanitizer: non-daemon thread(s) leaked by this test: "
+                f"{sorted(thread.name for thread in leaked_threads)} — join them in "
+                "teardown (or mark the test @pytest.mark.allow_thread_leaks)"
+            )
 
 
 @pytest.fixture

@@ -1,0 +1,430 @@
+"""hivemind-lint (ISSUE 16): tier-1 wiring plus self-tests for the suite.
+
+Three layers of coverage:
+
+1. the real tree is CLEAN — zero unsuppressed findings, zero stale allowlist
+   entries, whole 9-rule suite inside the tier-1 time budget;
+2. every rule actually catches what it claims to catch (MUST-flag fixtures in
+   ``tools/lint/fixtures/<rule>/flag.py``, each tied to a named historical bug
+   class) and does not cry wolf on the approved pattern (``ok.py``);
+3. the shared mechanics — ``# lint: allow(...)`` suppression, the
+   ``single-writer`` alias, justification-required allowlists, stale-entry
+   detection, CLI exit codes — behave as documented, plus the runtime side of
+   the fire-and-forget story: ``spawn()`` logs and counts background failures.
+"""
+
+import asyncio
+import json
+import logging
+import shutil
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT / "tools"))
+
+from lint import cli  # noqa: E402
+from lint.engine import LintContext, load_allowlist, run_rule, run_suite  # noqa: E402
+from lint.rules import ALL_RULES, get_rule  # noqa: E402
+
+FIXTURES = REPO_ROOT / "tools" / "lint" / "fixtures"
+
+# suite budget from ISSUE 16 acceptance: the full suite must stay tier-1-cheap
+SUITE_BUDGET_S = 15.0
+
+
+# --------------------------------------------------------------- the real tree
+
+
+def test_repo_tree_is_clean_and_fast():
+    """The tier-1 gate: no unsuppressed finding, no stale allowlist entry."""
+    suite = run_suite()
+    problems = [f.render() for r in suite.results for f in r.violations]
+    problems += [
+        f"stale allowlist entry for {r.rule.name}: {key}"
+        for r in suite.results
+        for key in r.stale_allowlist
+    ]
+    assert not problems, "hivemind-lint is dirty:\n  " + "\n  ".join(problems)
+    assert suite.duration_s < SUITE_BUDGET_S, (
+        f"lint suite took {suite.duration_s:.1f}s — over the {SUITE_BUDGET_S:.0f}s "
+        f"tier-1 budget; a rule regressed from AST-walk to something quadratic"
+    )
+
+
+def test_every_rule_names_its_bug_class():
+    """Each rule documents the historical defect it exists to prevent."""
+    for rule_cls in ALL_RULES:
+        assert rule_cls.name and rule_cls.title, rule_cls
+        assert len(rule_cls.rationale) > 40, f"{rule_cls.name}: rationale missing"
+
+
+# ------------------------------------------------------------- fixture pairs
+
+# (rule, where the fixture must live to be in the rule's scope, kinds flag.py
+#  must produce). hotpath-copies scans an explicit file list, so its fixture
+#  impersonates p2p/mux.py; tree-scoped rules get a file in a scanned subtree.
+_AST_CASES = [
+    ("adhoc-retries", "utils/mod.py", {"swallow", "retry-loop"}),
+    ("blocking-in-async", "p2p/mod.py", {"time-sleep", "blocking-io", "sync-socket"}),
+    ("hotpath-copies", "p2p/mux.py", {"bytes-concat", "copy-astype"}),
+    ("async-shared-state", "averaging/mod.py", {"interleaved:followers", "interleaved:pending"}),
+    ("fire-and-forget", "p2p/mod.py", {"dropped-task"}),
+    ("missing-deadline", "moe/mod.py", {"no-deadline"}),
+]
+
+
+def _fixture_ctx(tmp_path: Path, rule_name: str, variant: str, dest: str) -> LintContext:
+    package = tmp_path / "hivemind_tpu"
+    target = package / dest
+    target.parent.mkdir(parents=True, exist_ok=True)
+    shutil.copyfile(FIXTURES / rule_name / f"{variant}.py", target)
+    return LintContext(repo_root=tmp_path, package_root=package)
+
+
+@pytest.mark.parametrize("rule_name,dest,expected", _AST_CASES, ids=[c[0] for c in _AST_CASES])
+def test_rule_flags_its_bug_class(tmp_path, rule_name, dest, expected):
+    ctx = _fixture_ctx(tmp_path, rule_name, "flag", dest)
+    findings = get_rule(rule_name)().run(ctx)
+    assert {f.kind for f in findings} == expected, [f.render() for f in findings]
+
+
+@pytest.mark.parametrize("rule_name,dest,expected", _AST_CASES, ids=[c[0] for c in _AST_CASES])
+def test_rule_passes_the_approved_pattern(tmp_path, rule_name, dest, expected):
+    ctx = _fixture_ctx(tmp_path, rule_name, "ok", dest)
+    findings = get_rule(rule_name)().run(ctx)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_scoping_fixture_outside_rule_scope_is_ignored(tmp_path):
+    """hotpath-copies scans ONLY its named hot-path files: the same concat in
+    an unlisted module must not fire."""
+    ctx = _fixture_ctx(tmp_path, "hotpath-copies", "flag", "p2p/other.py")
+    assert get_rule("hotpath-copies")().run(ctx) == []
+
+
+# ----------------------------------------------------------- project rules
+
+
+def _project_ctx(tmp_path: Path) -> LintContext:
+    package = tmp_path / "hivemind_tpu"
+    package.mkdir(parents=True, exist_ok=True)
+    return LintContext(repo_root=tmp_path, package_root=package)
+
+
+def test_metric_docs_catches_drift_both_ways(tmp_path):
+    ctx = _project_ctx(tmp_path)
+    (tmp_path / "hivemind_tpu" / "mod.py").write_text(textwrap.dedent("""\
+        A = REGISTRY.counter("hivemind_documented_total", "d", ())
+        B = REGISTRY.counter("hivemind_phantom_total", "d", ())
+        name = "computed"
+        C = REGISTRY.gauge(name, "d")
+    """))
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text(
+        "| `hivemind_documented_total` | counter | — | fine |\n"
+        "| `hivemind_stale_total` | counter | — | registered nowhere |\n"
+    )
+    findings, warnings = get_rule("metric-docs")().run(ctx)
+    by_kind = {f.kind: f for f in findings}
+    assert set(by_kind) == {"undocumented-metric", "dynamic-metric-name"}
+    assert "hivemind_phantom_total" in by_kind["undocumented-metric"].message
+    assert any("hivemind_stale_total" in w for w in warnings), warnings
+
+
+def test_chaos_coverage_catches_every_drift_axis(tmp_path):
+    ctx = _project_ctx(tmp_path)
+    package = tmp_path / "hivemind_tpu"
+    (package / "resilience").mkdir()
+    (package / "hivemind_cli").mkdir()
+    (package / "resilience" / "chaos.py").write_text(
+        'INJECTION_POINTS = (\n    "dht.rpc_drop",\n    "net.stall",\n    "net.ghost",\n)\n'
+    )
+    (package / "hivemind_cli" / "run_chaos_soak.py").write_text(
+        "DEFAULT_SCHEDULE = (\n"
+        '    ("dht.rpc_drop", 0.1),\n'
+        '    ("net.stall", 0.1),\n'
+        '    ("net.typo", 0.1),\n'
+        ")\n"
+    )
+    (package / "caller.py").write_text('CHAOS.inject("net.bogus")\n')
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "resilience.md").write_text(
+        "prose may mention `net.anything` without being a catalog row\n"
+        "| `dht.rpc_drop` | drops RPCs |\n"
+        "| `net.stall` | stalls links |\n"
+        "| `net.removed` | point deleted from the engine |\n"
+    )
+    findings, _warnings = get_rule("chaos-coverage")().run(ctx)
+    assert {f.kind for f in findings} == {
+        "undocumented:net.ghost",  # declared, not in the doc
+        "unexercised:net.ghost",  # declared, not in DEFAULT_SCHEDULE
+        "phantom:net.typo",  # soaked, not declared
+        "stale-doc:net.removed",  # catalog row for a deleted point
+        "unknown:net.bogus",  # inject() literal for an undeclared point
+    }, [f.render() for f in findings]
+
+
+def _wire_tree(tmp_path: Path) -> LintContext:
+    """A tmp repo with the REAL proto modules + serialization + regenerator."""
+    package = tmp_path / "hivemind_tpu"
+    for rel in (
+        "proto/averaging_pb2.py",
+        "proto/dht_pb2.py",
+        "proto/runtime_pb2.py",
+        "proto/test_pb2.py",
+        "compression/serialization.py",
+    ):
+        dst = package / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copyfile(REPO_ROOT / "hivemind_tpu" / rel, dst)
+    (tmp_path / "tools").mkdir()
+    shutil.copyfile(REPO_ROOT / "tools" / "regen_proto.py", tmp_path / "tools" / "regen_proto.py")
+    return LintContext(repo_root=tmp_path, package_root=package)
+
+
+def test_wire_drift_clean_on_pristine_copies(tmp_path):
+    ctx = _wire_tree(tmp_path)
+    findings, _warnings = get_rule("wire-drift")().run(ctx)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_wire_drift_catches_hand_edited_pb2(tmp_path):
+    ctx = _wire_tree(tmp_path)
+    pb2 = tmp_path / "hivemind_tpu" / "proto" / "averaging_pb2.py"
+    pb2.write_text(pb2.read_text() + "\n# a hand edit the regenerator would erase\n")
+    findings, _warnings = get_rule("wire-drift")().run(ctx)
+    assert {f.kind for f in findings} == {"regen-drift"}, [f.render() for f in findings]
+
+
+def test_wire_drift_catches_renumbered_tag(tmp_path):
+    ctx = _wire_tree(tmp_path)
+    ser = tmp_path / "hivemind_tpu" / "compression" / "serialization.py"
+    source = ser.read_text()
+    assert "# ExpertRequest.metadata = 3" in source
+    ser.write_text(source.replace("# ExpertRequest.metadata = 3", "# ExpertRequest.metadata = 9"))
+    findings, _warnings = get_rule("wire-drift")().run(ctx)
+    assert {f.kind for f in findings} == {"tag-drift"}, [f.render() for f in findings]
+    assert any("_REQUEST_METADATA_TAG" in f.message for f in findings)
+
+
+def test_wire_drift_catches_unannotated_tag(tmp_path):
+    ctx = _wire_tree(tmp_path)
+    ser = tmp_path / "hivemind_tpu" / "compression" / "serialization.py"
+    ser.write_text(
+        ser.read_text().replace(
+            '_REQUEST_UID_TAG = b"\\x0a"  # ExpertRequest.uid = 1',
+            '_REQUEST_UID_TAG = b"\\x0a"',
+        )
+    )
+    findings, _warnings = get_rule("wire-drift")().run(ctx)
+    assert {f.kind for f in findings} == {"tag-unverifiable"}, [f.render() for f in findings]
+
+
+# ------------------------------------------------- suppression + allowlists
+
+
+def _dropped_task_ctx(tmp_path: Path, body: str) -> LintContext:
+    package = tmp_path / "hivemind_tpu"
+    package.mkdir(parents=True, exist_ok=True)
+    (package / "mod.py").write_text(textwrap.dedent(body))
+    return LintContext(repo_root=tmp_path, package_root=package)
+
+
+def test_line_suppression_moves_finding_to_suppressed(tmp_path):
+    ctx = _dropped_task_ctx(tmp_path, """\
+        import asyncio
+
+
+        async def go(coro):
+            asyncio.create_task(coro)  # lint: allow(fire-and-forget) — test fixture
+    """)
+    result = run_rule(get_rule("fire-and-forget")(), ctx, allowlist_dir=tmp_path / "nowhere")
+    assert not result.violations
+    assert len(result.suppressed) == 1
+
+
+def test_block_suppression_on_def_line_covers_the_body(tmp_path):
+    ctx = _dropped_task_ctx(tmp_path, """\
+        import asyncio
+
+
+        async def go(coro):  # lint: allow(fire-and-forget) — whole body reviewed
+            asyncio.create_task(coro)
+            asyncio.ensure_future(coro)
+    """)
+    result = run_rule(get_rule("fire-and-forget")(), ctx, allowlist_dir=tmp_path / "nowhere")
+    assert not result.violations
+    assert len(result.suppressed) == 2
+
+
+def test_single_writer_alias_suppresses_async_shared_state(tmp_path):
+    package = tmp_path / "hivemind_tpu"
+    (package / "p2p").mkdir(parents=True)
+    (package / "p2p" / "mod.py").write_text(textwrap.dedent("""\
+        class Pump:
+            async def drain(self, queue):
+                while True:
+                    item = await queue.get()
+                    self.pending.append(item)  # lint: single-writer — sole consumer
+    """))
+    ctx = LintContext(repo_root=tmp_path, package_root=package)
+    result = run_rule(
+        get_rule("async-shared-state")(), ctx, allowlist_dir=tmp_path / "nowhere"
+    )
+    assert not result.violations
+    assert len(result.suppressed) == 1
+
+
+def test_allowlist_requires_a_justification(tmp_path):
+    allowlists = tmp_path / "allowlists"
+    allowlists.mkdir()
+    (allowlists / "fire-and-forget.conf").write_text(
+        "hivemind_tpu/mod.py:go:dropped-task\n"
+    )
+    with pytest.raises(ValueError, match="justification"):
+        load_allowlist("fire-and-forget", allowlists)
+
+
+def test_allowlist_matches_by_key_and_reports_stale_entries(tmp_path):
+    ctx = _dropped_task_ctx(tmp_path, """\
+        import asyncio
+
+
+        async def go(coro):
+            asyncio.create_task(coro)
+    """)
+    allowlists = tmp_path / "allowlists"
+    allowlists.mkdir()
+    (allowlists / "fire-and-forget.conf").write_text(
+        "hivemind_tpu/mod.py:go:dropped-task  reviewed: fixture\n"
+        "hivemind_tpu/gone.py:old:dropped-task  the finding this covered is gone\n"
+    )
+    result = run_rule(get_rule("fire-and-forget")(), ctx, allowlist_dir=allowlists)
+    assert not result.violations
+    assert len(result.allowlisted) == 1
+    assert result.stale_allowlist == ["hivemind_tpu/gone.py:old:dropped-task"]
+
+
+def test_real_allowlists_all_carry_justifications():
+    for conf in sorted((REPO_ROOT / "tools" / "lint" / "allowlists").glob("*.conf")):
+        entries = load_allowlist(conf.stem)
+        for entry in entries.values():
+            assert len(entry.justification) > 10, f"{conf.name}: {entry.key}"
+
+
+# ------------------------------------------------------------------- the CLI
+
+
+def test_cli_exits_nonzero_and_emits_json_on_violation(tmp_path, capsys):
+    package = tmp_path / "hivemind_tpu"
+    package.mkdir()
+    (package / "mod.py").write_text(
+        "import asyncio\n\n\nasync def go(coro):\n    asyncio.create_task(coro)\n"
+    )
+    rc = cli.main(["--root", str(tmp_path), "--rule", "fire-and-forget", "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["ok"] is False
+    assert payload["total_violations"] == 1
+    finding = payload["rules"]["fire-and-forget"]["findings"][0]
+    assert finding["kind"] == "dropped-task"
+    assert finding["qualname"] == "go"
+
+
+def test_cli_clean_tree_exits_zero(tmp_path, capsys):
+    package = tmp_path / "hivemind_tpu"
+    package.mkdir()
+    (package / "mod.py").write_text("x = 1\n")
+    rc = cli.main(["--root", str(tmp_path), "--rule", "fire-and-forget"])
+    assert rc == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_lists_all_nine_rules(capsys):
+    assert cli.main(["--list-rules"]) == 0
+    listed = [line.split()[0] for line in capsys.readouterr().out.splitlines() if line]
+    assert listed == [rule_cls.name for rule_cls in ALL_RULES]
+    assert len(listed) == 9
+
+
+def test_cli_rejects_unknown_rule():
+    assert cli.main(["--rule", "no-such-rule"]) == 2
+
+
+# ----------------------------------------------- spawn(): the runtime half
+
+
+class _ListHandler(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+
+async def test_spawn_logs_and_counts_background_failures():
+    """The fire-and-forget rule forces tasks through spawn(); spawn() must hold
+    up its end — failures are logged AND counted, never silently retrieved."""
+    from hivemind_tpu.telemetry.registry import REGISTRY
+    from hivemind_tpu.utils.asyncio_utils import _background_tasks, spawn
+
+    counter = REGISTRY.counter(
+        "hivemind_background_task_errors_total", "", ("site",)
+    )
+    before = counter.value(site="test.spawn_failure")
+    # the project logger does not propagate to the root logger caplog hooks,
+    # so listen on the module logger directly
+    handler = _ListHandler()
+    logging.getLogger("hivemind_tpu.utils.asyncio_utils").addHandler(handler)
+
+    async def boom():
+        raise RuntimeError("fixture failure")
+
+    try:
+        task = spawn(boom(), name="test.spawn_failure")
+        assert task in _background_tasks  # strong ref: not GC-collectable mid-flight
+        with pytest.raises(RuntimeError):
+            await task
+        await asyncio.sleep(0)  # let the done-callback run
+    finally:
+        logging.getLogger("hivemind_tpu.utils.asyncio_utils").removeHandler(handler)
+
+    assert task not in _background_tasks
+    assert counter.value(site="test.spawn_failure") == before + 1
+    messages = [record.getMessage() for record in handler.records]
+    assert any(
+        "test.spawn_failure" in message and "fixture failure" in message
+        for message in messages
+    ), messages
+
+
+async def test_spawn_success_and_cancellation_are_not_counted():
+    from hivemind_tpu.telemetry.registry import REGISTRY
+    from hivemind_tpu.utils.asyncio_utils import spawn
+
+    counter = REGISTRY.counter(
+        "hivemind_background_task_errors_total", "", ("site",)
+    )
+    before = counter.value(site="test.spawn_clean")
+
+    async def fine():
+        return 7
+
+    async def forever():
+        await asyncio.Event().wait()
+
+    ok_task = spawn(fine(), name="test.spawn_clean")
+    assert await ok_task == 7
+    cancelled = spawn(forever(), name="test.spawn_clean")
+    cancelled.cancel()
+    with pytest.raises(asyncio.CancelledError):
+        await cancelled
+    await asyncio.sleep(0)
+    assert counter.value(site="test.spawn_clean") == before

@@ -402,21 +402,7 @@ async def test_dht_store_get_under_rpc_drops():
         await asyncio.gather(*(node.shutdown() for node in nodes))
 
 
-# ------------------------------------------------------------------- lint + soak
-
-
-def test_no_new_adhoc_failure_handling():
-    """tools/check_adhoc_retries.py: no NEW bare `except Exception: pass` or
-    hand-rolled sleep-retry loops outside hivemind_tpu/resilience/."""
-    import importlib.util
-    from pathlib import Path
-
-    tool_path = Path(__file__).resolve().parent.parent / "tools" / "check_adhoc_retries.py"
-    spec = importlib.util.spec_from_file_location("check_adhoc_retries", tool_path)
-    module = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(module)
-    new, _stale = module.check()
-    assert not new, "new ad-hoc failure handling outside resilience/:\n" + "\n".join(new)
+# ------------------------------------------------------------------------- soak
 
 
 @pytest.mark.chaos

@@ -1,0 +1,369 @@
+"""The shared AST-walk engine behind every hivemind-lint rule (ISSUE 16).
+
+Replaces the four bespoke walkers that used to live in tools/check_*.py: one
+parse per module, one suppression syntax, one allowlist format, one runner.
+
+Key objects:
+
+- :class:`LintContext` — parses every ``*.py`` under the package root exactly
+  once and hands rules :class:`ParsedModule` objects (tree + source + the
+  in-source suppressions already extracted).
+- :class:`Rule` / :class:`AstRule` — a rule declares its scope (subtrees or an
+  explicit file list) and returns raw :class:`Finding` objects; the runner
+  applies suppressions and allowlists centrally, so no rule reimplements them.
+- :func:`run_suite` — runs rules, partitions findings into violations /
+  suppressed / allowlisted, reports stale allowlist entries, and times each
+  rule (the whole 9-rule suite must stay under the tier-1 budget).
+
+Findings are keyed ``(repo-relative path, enclosing qualname, kind)`` — stable
+across line-number churn, same convention the old checkers used.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+ALLOWLIST_DIR = Path(__file__).resolve().parent / "allowlists"
+
+# `# lint: allow(rule-a, rule-b)` — suppress on this line (or this whole block
+# when the comment sits on a def/class line). `# lint: single-writer` is the
+# async-shared-state annotation from the rule's docstring: "this attribute has
+# exactly one writing coroutine by design".
+_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([^)]*)\)")
+_SINGLE_WRITER_RE = re.compile(r"#\s*lint:\s*single-writer\b")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    relpath: str  # repo-relative path, e.g. "hivemind_tpu/p2p/relay.py"
+    lineno: int
+    qualname: str  # enclosing function/class dotted path, or "<module>"
+    kind: str
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Line-number-free identity used by allowlist files."""
+        return f"{self.relpath}:{self.qualname}:{self.kind}"
+
+    def render(self) -> str:
+        return f"{self.relpath}:{self.lineno} [{self.rule}/{self.kind}] in {self.qualname} — {self.message}"
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule, "path": self.relpath, "line": self.lineno,
+            "qualname": self.qualname, "kind": self.kind, "message": self.message,
+        }
+
+
+class ParsedModule:
+    """One parsed source file: tree, lines, and extracted suppressions."""
+
+    def __init__(self, path: Path, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.tree = ast.parse(source, filename=str(path))
+        self.lines = source.splitlines()
+        self._line_allow: Dict[int, Set[str]] = {}
+        for lineno, line in enumerate(self.lines, start=1):
+            allowed: Set[str] = set()
+            match = _ALLOW_RE.search(line)
+            if match:
+                allowed |= {part.strip() for part in match.group(1).split(",") if part.strip()}
+            if _SINGLE_WRITER_RE.search(line):
+                allowed.add("async-shared-state")
+            if allowed:
+                self._line_allow[lineno] = allowed
+        # a suppression on a def/class line covers that whole block
+        self._block_allow: List[Tuple[int, int, Set[str]]] = []
+        if self._line_allow:
+            for node in ast.walk(self.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                    rules = self._line_allow.get(node.lineno)
+                    if rules:
+                        self._block_allow.append((node.lineno, node.end_lineno or node.lineno, rules))
+
+    def is_suppressed(self, rule: str, lineno: int) -> bool:
+        line_rules = self._line_allow.get(lineno)
+        if line_rules and rule in line_rules:
+            return True
+        for start, end, rules in self._block_allow:
+            if start <= lineno <= end and rule in rules:
+                return True
+        return False
+
+    def suppression_count(self, rule: str) -> int:
+        """How many in-source suppressions name this rule (tracked as lint debt)."""
+        total = sum(1 for rules in self._line_allow.values() if rule in rules)
+        return total
+
+
+class LintContext:
+    """Parses the package once; every rule reads from the same cache."""
+
+    def __init__(self, repo_root: Path = REPO_ROOT, package_root: Optional[Path] = None):
+        self.repo_root = Path(repo_root)
+        self.package_root = Path(package_root) if package_root is not None else self.repo_root / "hivemind_tpu"
+        self._modules: Optional[Dict[str, ParsedModule]] = None
+
+    def _relpath(self, path: Path) -> str:
+        try:
+            return str(path.relative_to(self.repo_root))
+        except ValueError:
+            return str(path)
+
+    def modules(self) -> Dict[str, ParsedModule]:
+        """Every package module, keyed by repo-relative path."""
+        if self._modules is None:
+            self._modules = {}
+            for path in sorted(self.package_root.rglob("*.py")):
+                if "__pycache__" in path.parts:
+                    continue
+                relpath = self._relpath(path)
+                self._modules[relpath] = ParsedModule(path, relpath, path.read_text())
+        return self._modules
+
+    def module(self, relpath: str) -> Optional[ParsedModule]:
+        return self.modules().get(relpath)
+
+    def package_relpath(self, package_file: str) -> str:
+        """Repo-relative path of a package-relative file ("p2p/mux.py")."""
+        return self._relpath(self.package_root / package_file)
+
+    def select_modules(
+        self,
+        trees: Optional[Sequence[str]] = None,
+        files: Optional[Sequence[str]] = None,
+        exclude_trees: Sequence[str] = (),
+    ) -> List[ParsedModule]:
+        """Rule scoping: explicit package-relative files, or package subtrees
+        (``None`` = the whole package), minus excluded subtrees."""
+        if files is not None:
+            out = []
+            for package_file in files:
+                module = self.module(self.package_relpath(package_file))
+                if module is not None:
+                    out.append(module)
+            return out
+        selected = []
+        for module in self.modules().values():
+            parts = module.path.relative_to(self.package_root).parts
+            if parts and parts[0] in exclude_trees:
+                continue
+            if trees is not None and (not parts or parts[0] not in trees):
+                continue
+            selected.append(module)
+        return selected
+
+    def read_text(self, repo_relative: str) -> Optional[str]:
+        path = self.repo_root / repo_relative
+        if not path.is_file():
+            return None
+        return path.read_text()
+
+
+class Rule:
+    """Base: a named analyzer. ``run`` returns RAW findings; suppression and
+    allowlisting are the runner's job."""
+
+    name: str = ""
+    title: str = ""
+    rationale: str = ""  # the historical bug class this rule exists to prevent
+
+    def run(self, ctx: LintContext) -> List[Finding]:
+        raise NotImplementedError
+
+    def finding(self, relpath: str, lineno: int, qualname: str, kind: str, message: str) -> Finding:
+        return Finding(self.name, relpath, lineno, qualname, kind, message)
+
+
+class AstRule(Rule):
+    """A rule that walks module ASTs. Scope via ``trees`` (package subtrees),
+    ``files`` (explicit package-relative paths) or neither (whole package)."""
+
+    trees: Optional[Tuple[str, ...]] = None
+    files: Optional[Tuple[str, ...]] = None
+    exclude_trees: Tuple[str, ...] = ()
+
+    def run(self, ctx: LintContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for module in ctx.select_modules(self.trees, self.files, self.exclude_trees):
+            findings.extend(self.check_module(module))
+        return findings
+
+    def check_module(self, module: ParsedModule) -> List[Finding]:
+        raise NotImplementedError
+
+
+class ScopedVisitor(ast.NodeVisitor):
+    """Shared qualname/async-scope tracking (what every old checker re-rolled)."""
+
+    def __init__(self, module: ParsedModule):
+        self.module = module
+        self._scope: List[str] = []
+        self._func_kind: List[str] = []  # "async" | "sync" | "class"
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._enter(node.name, "sync")
+        self.generic_visit(node)
+        self._exit()
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self._enter(node.name, "async")
+        self.generic_visit(node)
+        self._exit()
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._enter(node.name, "class")
+        self.generic_visit(node)
+        self._exit()
+
+    def _enter(self, name: str, kind: str) -> None:
+        self._scope.append(name)
+        self._func_kind.append(kind)
+
+    def _exit(self) -> None:
+        self._scope.pop()
+        self._func_kind.pop()
+
+    def in_async_function(self) -> bool:
+        """True when the innermost enclosing FUNCTION is async (classes are
+        transparent — a method counts by its own kind)."""
+        for kind in reversed(self._func_kind):
+            if kind == "class":
+                continue
+            return kind == "async"
+        return False
+
+    def qualname(self) -> str:
+        return ".".join(self._scope) if self._scope else "<module>"
+
+
+# --------------------------------------------------------------------- allowlists
+
+
+@dataclass
+class AllowlistEntry:
+    key: str  # relpath:qualname:kind
+    justification: str
+
+
+def load_allowlist(rule_name: str, allowlist_dir: Path = ALLOWLIST_DIR) -> Dict[str, AllowlistEntry]:
+    """``tools/lint/allowlists/<rule>.conf``: one entry per line,
+    ``<path>:<qualname>:<kind>  <justification>``. A justification is REQUIRED —
+    zero silent grandfathering (ISSUE 16 satellite)."""
+    path = allowlist_dir / f"{rule_name}.conf"
+    entries: Dict[str, AllowlistEntry] = {}
+    if not path.is_file():
+        return entries
+    for raw_line in path.read_text().splitlines():
+        line = raw_line.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, _, justification = line.partition("  ")
+        justification = justification.strip()
+        if not justification:
+            raise ValueError(
+                f"{path.name}: allowlist entry {key!r} has no justification — every "
+                f"grandfathered finding must say why (two spaces separate key from reason)"
+            )
+        entries[key.strip()] = AllowlistEntry(key.strip(), justification)
+    return entries
+
+
+# --------------------------------------------------------------------- runner
+
+
+@dataclass
+class RuleResult:
+    rule: Rule
+    violations: List[Finding] = field(default_factory=list)
+    suppressed: List[Finding] = field(default_factory=list)
+    allowlisted: List[Finding] = field(default_factory=list)
+    stale_allowlist: List[str] = field(default_factory=list)
+    warnings: List[str] = field(default_factory=list)
+    duration_s: float = 0.0
+
+    def to_json(self, include_findings: bool = True) -> Dict[str, object]:
+        out: Dict[str, object] = {
+            "violations": len(self.violations),
+            "suppressed": len(self.suppressed),
+            "allowlisted": len(self.allowlisted),
+            "stale_allowlist": len(self.stale_allowlist),
+            "warnings": len(self.warnings),
+            "duration_s": round(self.duration_s, 4),
+        }
+        if include_findings:
+            out["findings"] = [finding.to_json() for finding in self.violations]
+        return out
+
+
+@dataclass
+class SuiteResult:
+    results: List[RuleResult]
+    duration_s: float
+
+    @property
+    def total_violations(self) -> int:
+        return sum(len(result.violations) for result in self.results)
+
+    @property
+    def ok(self) -> bool:
+        return self.total_violations == 0
+
+    def to_json(self, include_findings: bool = True) -> Dict[str, object]:
+        return {
+            "ok": self.ok,
+            "total_violations": self.total_violations,
+            "total_suppressed": sum(len(r.suppressed) for r in self.results),
+            "total_allowlisted": sum(len(r.allowlisted) for r in self.results),
+            "duration_s": round(self.duration_s, 3),
+            "rules": {
+                result.rule.name: result.to_json(include_findings) for result in self.results
+            },
+        }
+
+
+def run_rule(rule: Rule, ctx: LintContext, allowlist_dir: Path = ALLOWLIST_DIR) -> RuleResult:
+    started = time.perf_counter()
+    raw = rule.run(ctx)
+    allowlist = load_allowlist(rule.name, allowlist_dir)
+    result = RuleResult(rule=rule)
+    if isinstance(raw, tuple):  # project rules may return (findings, warnings)
+        raw, result.warnings = raw[0], list(raw[1])
+    seen_keys: Set[str] = set()
+    for finding in raw:
+        seen_keys.add(finding.key)
+        module = ctx.modules().get(finding.relpath)
+        if module is not None and module.is_suppressed(rule.name, finding.lineno):
+            result.suppressed.append(finding)
+        elif finding.key in allowlist:
+            result.allowlisted.append(finding)
+        else:
+            result.violations.append(finding)
+    result.stale_allowlist = sorted(set(allowlist) - seen_keys)
+    result.duration_s = time.perf_counter() - started
+    return result
+
+
+def run_suite(
+    rules: Optional[Iterable[Rule]] = None,
+    ctx: Optional[LintContext] = None,
+    allowlist_dir: Path = ALLOWLIST_DIR,
+) -> SuiteResult:
+    from lint.rules import ALL_RULES
+
+    if rules is None:
+        rules = [rule_cls() for rule_cls in ALL_RULES]
+    ctx = ctx if ctx is not None else LintContext()
+    started = time.perf_counter()
+    results = [run_rule(rule, ctx, allowlist_dir) for rule in rules]
+    return SuiteResult(results=results, duration_s=time.perf_counter() - started)

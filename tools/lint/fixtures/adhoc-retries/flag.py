@@ -1,0 +1,24 @@
+"""MUST-flag fixture for ``adhoc-retries``: the pre-ISSUE-3 shapes that hid
+real faults before the resilience layer existed."""
+
+import time
+
+
+def risky():
+    raise RuntimeError
+
+
+def swallow():
+    try:
+        risky()
+    except Exception:
+        pass
+
+
+def retry_loop():
+    while True:
+        try:
+            return risky()
+        except Exception:
+            pass
+        time.sleep(1.0)

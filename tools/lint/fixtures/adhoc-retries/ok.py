@@ -1,0 +1,27 @@
+"""MUST-pass fixture for ``adhoc-retries``: narrow exception types and
+log-and-count handlers are the approved shapes."""
+
+import logging
+import time
+
+logger = logging.getLogger(__name__)
+
+
+def risky():
+    raise RuntimeError
+
+
+def narrow_swallow():
+    try:
+        risky()
+    except ValueError:
+        pass  # narrow type: a deliberate, reviewable decision
+
+
+def logged_loop():
+    while True:
+        try:
+            return risky()
+        except Exception as exc:
+            logger.warning(f"retrying after {exc!r}")  # visible, countable
+        time.sleep(1.0)

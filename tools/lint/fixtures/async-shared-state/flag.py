@@ -1,0 +1,17 @@
+"""MUST-flag fixture for ``async-shared-state``: the matchmaking
+``current_followers`` race shape — a ``self.*`` container mutated on both
+sides of an RPC await (another coroutine interleaves in between), and a
+counter bumped inside a loop that awaits."""
+
+
+class Matchmaker:
+    async def join(self, peer, rpc):
+        self.followers[peer] = "pending"
+        reply = await rpc(peer)
+        self.followers[peer] = reply  # straddles the await: interleaving clobbers
+        return reply
+
+    async def drain(self, queue):
+        while True:
+            item = await queue.get()
+            self.pending.append(item)  # mutation spans awaits across iterations

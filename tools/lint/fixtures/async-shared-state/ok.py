@@ -1,0 +1,18 @@
+"""MUST-pass fixture for ``async-shared-state``: a single post-await mutation
+(atomic under the GIL), a lock-guarded update, and a plain rebind."""
+
+
+class Matchmaker:
+    async def join(self, peer, rpc):
+        reply = await rpc(peer)
+        self.followers[peer] = reply  # one mutation: nothing to interleave with
+        return reply
+
+    async def drain(self, queue):
+        while True:
+            item = await queue.get()
+            async with self.lock:
+                self.pending.append(item)  # lock-guarded: exempt
+
+    async def refresh(self, rpc):
+        self.snapshot = await rpc()  # plain rebind is atomic, never an event

@@ -1,0 +1,13 @@
+"""MUST-flag fixture for ``blocking-in-async``: each call stalls the swarm's
+shared event loop (the ISSUE 8 watchdog catches these at runtime; the lint
+keeps them from being written)."""
+
+import socket
+import time
+
+
+async def stalls_the_loop(path):
+    time.sleep(0.1)
+    data = open(path).read()
+    conn = socket.create_connection(("host", 1))
+    return data, conn

@@ -1,0 +1,17 @@
+"""MUST-pass fixture for ``blocking-in-async``: the approved loop-friendly
+counterparts, plus blocking IO inside a nested SYNC def (the standard
+run-in-executor target shape)."""
+
+import asyncio
+
+
+def _read_blocking(path):
+    with open(path) as f:  # sync def: an executor target, not on the loop
+        return f.read()
+
+
+async def polite(path, run_in_executor):
+    await asyncio.sleep(0.1)
+    data = await run_in_executor(_read_blocking, path)
+    reader, writer = await asyncio.open_connection("host", 1)
+    return data, reader, writer

@@ -1,0 +1,12 @@
+"""MUST-flag fixture for ``fire-and-forget``: dropped task handles — the relay
+accept-loop / matchmaking key-refresh bug shape. asyncio keeps only a weak
+reference; the task is collectable mid-flight and its exception rots until
+interpreter shutdown."""
+
+import asyncio
+
+
+async def start(loop, coro):
+    asyncio.create_task(coro)
+    asyncio.ensure_future(coro)
+    loop.create_task(coro)

@@ -1,0 +1,15 @@
+"""MUST-pass fixture for ``fire-and-forget``: the approved shapes — tracked
+``spawn(coro, name=...)``, a stored-and-awaited handle, and a cancelled one."""
+
+import asyncio
+
+
+async def start(coro, other, spawn):
+    spawn(coro, name="fixture.start")  # tracked: strong ref + logged + counted
+    task = asyncio.create_task(other)
+    await task
+
+
+async def start_and_cancel(coro):
+    task = asyncio.ensure_future(coro)
+    task.cancel()

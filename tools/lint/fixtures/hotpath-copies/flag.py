@@ -1,0 +1,10 @@
+"""MUST-flag fixture for ``hotpath-copies``: the two copy shapes that cost
+~30% of averaging throughput before ISSUE 6/10 removed them."""
+
+
+def frame(header, payload):
+    return header.pack() + payload  # doubles every megabyte payload
+
+
+def convert(array, dtype):
+    return array.astype(dtype)  # copies even when dtype already matches

@@ -1,0 +1,10 @@
+"""MUST-pass fixture for ``hotpath-copies``: scatter-gather framing and
+explicit-copy astype."""
+
+
+def frame(header, payload):
+    return [header.pack(), payload]  # scatter-gather: writev sends both
+
+
+def convert(array, dtype):
+    return array.astype(dtype, copy=False)

@@ -1,0 +1,13 @@
+"""MUST-flag fixture for ``missing-deadline``: the replication-fetch bug shape
+— a network await with no deadline machinery anywhere in the function body. A
+signature parameter alone deliberately does NOT count: an accepted-but-unused
+``chunk_timeout`` is precisely the defect this rule exists to find."""
+
+
+async def fetch(stub, request):
+    return await stub.call_protobuf_handler("rpc_fetch", request)
+
+
+async def fetch_replica_state(stub, request, chunk_timeout):
+    async for part in stub.iterate_protobuf_handler("rpc_fetch_stream", request):
+        yield part

@@ -1,0 +1,17 @@
+"""MUST-pass fixture for ``missing-deadline``: the deadline actually reaches
+the network await — wait_for on the unary path, aiter_with_timeout (with the
+parameter USED in the body) on the stream path."""
+
+import asyncio
+
+
+async def fetch_unary(stub, request):
+    return await asyncio.wait_for(
+        stub.call_protobuf_handler("rpc_fetch", request), timeout=10.0
+    )
+
+
+async def fetch_replica_state(stub, request, chunk_timeout, aiter_with_timeout):
+    stream = stub.iterate_protobuf_handler("rpc_fetch_stream", request)
+    async for part in aiter_with_timeout(stream, chunk_timeout):
+        yield part

@@ -1,0 +1,36 @@
+"""Rule registry: the full 9-rule hivemind-lint suite (ISSUE 16).
+
+Four ported from the old standalone checkers (tools/check_*.py, now deleted),
+five new analyzers. Order here is display order."""
+
+from lint.rules.adhoc_retries import AdhocRetriesRule
+from lint.rules.async_shared_state import AsyncSharedStateRule
+from lint.rules.blocking_in_async import BlockingInAsyncRule
+from lint.rules.chaos_coverage import ChaosCoverageRule
+from lint.rules.fire_and_forget import FireAndForgetRule
+from lint.rules.hotpath_copies import HotpathCopiesRule
+from lint.rules.metric_docs import MetricDocsRule
+from lint.rules.missing_deadline import MissingDeadlineRule
+from lint.rules.wire_drift import WireDriftRule
+
+ALL_RULES = (
+    AdhocRetriesRule,
+    BlockingInAsyncRule,
+    HotpathCopiesRule,
+    MetricDocsRule,
+    AsyncSharedStateRule,
+    FireAndForgetRule,
+    MissingDeadlineRule,
+    WireDriftRule,
+    ChaosCoverageRule,
+)
+
+_BY_NAME = {rule_cls.name: rule_cls for rule_cls in ALL_RULES}
+assert len(_BY_NAME) == len(ALL_RULES), "duplicate rule names"
+
+
+def get_rule(name: str):
+    try:
+        return _BY_NAME[name]
+    except KeyError:
+        raise KeyError(f"unknown rule {name!r}; known: {sorted(_BY_NAME)}") from None

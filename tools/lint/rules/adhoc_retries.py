@@ -1,0 +1,96 @@
+"""Rule ``adhoc-retries``: keep failure handling in the resilience layer.
+
+Ported from tools/check_adhoc_retries.py (ISSUE 3 satellite). Flags, everywhere
+under the package EXCEPT ``resilience/``:
+
+- ``swallow`` — a bare/broad ``except`` whose body is exactly ``pass``: silent
+  failure handling. Log + count, or narrow the exception type.
+- ``retry-loop`` — a loop that both sleeps and swallows broad exceptions to
+  keep looping: a hand-rolled retry. Use
+  :class:`hivemind_tpu.resilience.RetryPolicy`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from lint.engine import AstRule, Finding, ParsedModule, ScopedVisitor
+
+
+def _broad_handler(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    if isinstance(handler.type, ast.Name) and handler.type.id in ("Exception", "BaseException"):
+        return True
+    if isinstance(handler.type, ast.Tuple):
+        return any(
+            isinstance(element, ast.Name) and element.id in ("Exception", "BaseException")
+            for element in handler.type.elts
+        )
+    return False
+
+
+def _is_sleep_call(node: ast.AST) -> bool:
+    call = node.value if isinstance(node, ast.Await) else node
+    if not isinstance(call, ast.Call):
+        return False
+    fn = call.func
+    return (
+        isinstance(fn, ast.Attribute)
+        and fn.attr == "sleep"
+        and isinstance(fn.value, ast.Name)
+        and fn.value.id in ("asyncio", "time")
+    )
+
+
+class _Visitor(ScopedVisitor):
+    def __init__(self, rule: "AdhocRetriesRule", module: ParsedModule):
+        super().__init__(module)
+        self.rule = rule
+        self.findings: List[Finding] = []
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        if _broad_handler(node) and len(node.body) == 1 and isinstance(node.body[0], ast.Pass):
+            self.findings.append(self.rule.finding(
+                self.module.relpath, node.lineno, self.qualname(), "swallow",
+                "broad `except: pass` — log + count instead of silently passing",
+            ))
+        self.generic_visit(node)
+
+    def _visit_loop(self, node):
+        sleeps = any(_is_sleep_call(child) for child in ast.walk(node))
+        swallows_to_loop = False
+        for child in ast.walk(node):
+            if not isinstance(child, ast.Try):
+                continue
+            for handler in child.handlers:
+                if not _broad_handler(handler):
+                    continue
+                # "keep looping silently" shapes: pass / continue only — a handler
+                # that logs and counts before continuing is the approved pattern
+                if all(isinstance(stmt, (ast.Pass, ast.Continue)) for stmt in handler.body):
+                    swallows_to_loop = True
+        if sleeps and swallows_to_loop:
+            self.findings.append(self.rule.finding(
+                self.module.relpath, node.lineno, self.qualname(), "retry-loop",
+                "hand-rolled retry loop — use RetryPolicy from hivemind_tpu.resilience",
+            ))
+        self.generic_visit(node)
+
+    visit_While = visit_For = visit_AsyncFor = _visit_loop
+
+
+class AdhocRetriesRule(AstRule):
+    name = "adhoc-retries"
+    title = "failure handling stays in the resilience layer"
+    rationale = (
+        "ISSUE 3: scattered bare `except: pass` and hand-rolled sleep-and-retry loops hid "
+        "real faults before the RetryPolicy/breaker layer existed; this keeps them out."
+    )
+    exclude_trees = ("resilience",)
+
+    def check_module(self, module: ParsedModule) -> List[Finding]:
+        visitor = _Visitor(self, module)
+        visitor.visit(module.tree)
+        return visitor.findings

@@ -1,0 +1,231 @@
+"""Rule ``async-shared-state``: read-modify-write on ``self.*`` must not span
+an ``await``.
+
+New in ISSUE 16. An ``await`` is a scheduling point: any other coroutine may
+run and see — or clobber — shared state mid-update. The matchmaking
+``current_followers``/``assembled`` races (ISSUE 3 era) were exactly this
+shape: a ``self.<dict>`` mutated before an RPC await and again after it, with
+a second coroutine interleaving in between.
+
+Per ``async def``, we collect mutation events of ``self.<attr>`` containers
+and counters:
+
+- ``self.attr += ...`` / ``self.attr -= ...`` (counter read-modify-write),
+- ``self.attr[k] = ...`` / ``del self.attr[k]`` / ``self.attr[k] += ...``,
+- mutator method calls: ``self.attr.append/add/update/pop/...``.
+
+An attribute is flagged (kind ``interleaved:<attr>``) when its mutations
+straddle at least one await point, or sit inside a loop that also awaits
+(the mutation spans awaits across iterations). Mutations inside a
+``with``/``async with`` whose context manager looks like a lock
+(``*lock*``/``*mutex*``/``*cond*``/``*sem*`` in the expression) are exempt,
+as is anything on a line or block annotated ``# lint: single-writer``
+(engine-level alias for ``# lint: allow(async-shared-state)``).
+
+Plain rebinds (``self.attr = x``) are NOT events: a single assignment is
+atomic under the GIL and flagging every post-await rebind drowns the signal.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Tuple
+
+from lint.engine import AstRule, Finding, ParsedModule
+
+_MUTATORS = {
+    "append", "appendleft", "add", "insert", "extend", "update", "setdefault",
+    "pop", "popleft", "popitem", "remove", "discard", "clear", "put_nowait",
+}
+_LOCKLIKE = re.compile(r"lock|mutex|cond|sem", re.IGNORECASE)
+
+
+def _self_attr(node: ast.AST) -> str:
+    """'attr' when node is ``self.attr``, else ''."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return ""
+
+
+def _looks_like_lock(expr: ast.AST) -> bool:
+    for sub in ast.walk(expr):
+        name = ""
+        if isinstance(sub, ast.Attribute):
+            name = sub.attr
+        elif isinstance(sub, ast.Name):
+            name = sub.id
+        if name and _LOCKLIKE.search(name):
+            return True
+    return False
+
+
+class _FunctionScan:
+    """Order-sensitive walk of ONE async def body (nested defs skipped).
+
+    Tracks the await counter, lock-guard depth, and whether we are inside a
+    loop whose body awaits; records per-attribute mutation events."""
+
+    def __init__(self) -> None:
+        self.awaits_seen = 0
+        self._lock_depth = 0
+        self._awaiting_loop_depth = 0
+        # attr -> list of (awaits_seen_at_mutation, inside_awaiting_loop, lineno)
+        self.events: Dict[str, List[Tuple[int, bool, int]]] = {}
+
+    def _record(self, attr: str, lineno: int) -> None:
+        if self._lock_depth > 0 or not attr:
+            return
+        self.events.setdefault(attr, []).append(
+            (self.awaits_seen, self._awaiting_loop_depth > 0, lineno)
+        )
+
+    def _contains_await(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.AsyncFunctionDef, ast.FunctionDef, ast.Lambda)) and sub is not node:
+                continue
+            if isinstance(sub, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+                return True
+        return False
+
+    def scan(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return  # nested function: its own coroutine frame, not this one
+        if isinstance(node, ast.Await):
+            self.awaits_seen += 1
+            self._scan_children(node)
+            return
+        if isinstance(node, ast.AugAssign):
+            # self.attr += 1  /  self.attr[k] += 1
+            target = node.target
+            self._record(_self_attr(target), node.lineno)
+            if isinstance(target, ast.Subscript):
+                self._record(_self_attr(target.value), node.lineno)
+            self._scan_children(node)
+            return
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Subscript):
+                    self._record(_self_attr(target.value), node.lineno)
+            self._scan_children(node)
+            return
+        if isinstance(node, ast.Delete):
+            for target in node.targets:
+                if isinstance(target, ast.Subscript):
+                    self._record(_self_attr(target.value), node.lineno)
+            self._scan_children(node)
+            return
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr in _MUTATORS:
+                self._record(_self_attr(fn.value), node.lineno)
+            self._scan_children(node)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            locked = any(_looks_like_lock(item.context_expr) for item in node.items)
+            for item in node.items:
+                self.scan(item.context_expr)
+            if isinstance(node, ast.AsyncWith):
+                self.awaits_seen += 1  # __aenter__ is an await point
+            if locked:
+                self._lock_depth += 1
+            for stmt in node.body:
+                self.scan(stmt)
+            if locked:
+                self._lock_depth -= 1
+            return
+        if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+            awaiting_loop = isinstance(node, ast.AsyncFor) or self._contains_await(node)
+            if isinstance(node, ast.For):
+                self.scan(node.iter)
+            elif isinstance(node, ast.AsyncFor):
+                self.scan(node.iter)
+                self.awaits_seen += 1  # each __anext__ is an await point
+            else:
+                self.scan(node.test)
+            if awaiting_loop:
+                self._awaiting_loop_depth += 1
+            for stmt in node.body:
+                self.scan(stmt)
+            if awaiting_loop:
+                self._awaiting_loop_depth -= 1
+                self.awaits_seen += 1  # loop body awaited at least once notionally
+            for stmt in node.orelse:
+                self.scan(stmt)
+            return
+        self._scan_children(node)
+
+    def _scan_children(self, node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            self.scan(child)
+
+
+class AsyncSharedStateRule(AstRule):
+    name = "async-shared-state"
+    title = "self.* container/counter mutations must not straddle an await"
+    rationale = (
+        "The matchmaking group-assembly races: self.<dict> mutated before an RPC await "
+        "and again after it let a second coroutine interleave and corrupt the group "
+        "roster. Any read-modify-write spanning a scheduling point is this bug."
+    )
+    trees = ("p2p", "dht", "averaging", "moe", "optim", "sim")
+
+    def check_module(self, module: ParsedModule) -> List[Finding]:
+        findings: List[Finding] = []
+        scope: List[str] = []
+
+        def walk(node: ast.AST) -> None:
+            if isinstance(node, ast.ClassDef):
+                scope.append(node.name)
+                for child in node.body:
+                    walk(child)
+                scope.pop()
+                return
+            if isinstance(node, ast.AsyncFunctionDef):
+                scope.append(node.name)
+                scan = _FunctionScan()
+                for stmt in node.body:
+                    scan.scan(stmt)
+                qualname = ".".join(scope)
+                for attr, events in sorted(scan.events.items()):
+                    counts = [awaits for awaits, _, _ in events]
+                    looped = any(in_loop for _, in_loop, _ in events)
+                    if looped or min(counts) < max(counts):
+                        lineno = min(line for _, _, line in events)
+                        findings.append(self.finding(
+                            module.relpath, lineno, qualname, f"interleaved:{attr}",
+                            f"self.{attr} is mutated across an await point in {qualname} — "
+                            f"another coroutine can interleave mid-update; hold an "
+                            f"asyncio.Lock or mark the line `# lint: single-writer`",
+                        ))
+                # nested defs are skipped by the scan (own coroutine frame) but
+                # still deserve their own analysis
+                def nested(sub: ast.AST) -> None:
+                    for child in ast.iter_child_nodes(sub):
+                        if isinstance(child, (ast.AsyncFunctionDef, ast.FunctionDef, ast.ClassDef)):
+                            walk(child)
+                        else:
+                            nested(child)
+
+                for stmt in node.body:
+                    if isinstance(stmt, (ast.AsyncFunctionDef, ast.FunctionDef, ast.ClassDef)):
+                        walk(stmt)
+                    else:
+                        nested(stmt)
+                scope.pop()
+                return
+            if isinstance(node, ast.FunctionDef):
+                scope.append(node.name)
+                for child in node.body:
+                    walk(child)
+                scope.pop()
+                return
+            for child in ast.iter_child_nodes(node):
+                walk(child)
+
+        walk(module.tree)
+        return findings

@@ -1,0 +1,82 @@
+"""Rule ``blocking-in-async``: no blocking calls inside ``async def`` on the
+swarm's shared event loop.
+
+Ported from tools/check_blocking_in_async.py (ISSUE 8 satellite). A single
+synchronous call inside a coroutine stalls matchmaking, DHT RPCs and part
+streams for the whole process — to the rest of the swarm the peer looks like a
+network straggler. Flagged only when the INNERMOST enclosing function is
+``async def`` (a nested sync ``def`` is the standard run-in-executor pattern):
+
+- ``time-sleep`` — ``time.sleep(...)``: use ``await asyncio.sleep(...)``.
+- ``blocking-io`` — ``open(...)`` / ``.read_text()`` & friends: run_in_executor.
+- ``sync-socket`` — ``socket.socket(...)`` etc.: use loop transports.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from lint.engine import AstRule, Finding, ParsedModule, ScopedVisitor
+
+_PATHLIKE_IO_METHODS = {"read_text", "read_bytes", "write_text", "write_bytes"}
+_SOCKET_BLOCKING_FUNCS = {"socket", "create_connection", "getaddrinfo", "socketpair"}
+
+_ADVICE = {
+    "time-sleep": "use `await asyncio.sleep(...)` — time.sleep blocks the whole swarm loop",
+    "blocking-io": "move file IO off the loop (run_in_executor in utils/asyncio_utils.py)",
+    "sync-socket": "use the loop's transports (open_connection / loop.sock_*) or an executor",
+}
+
+
+class _Visitor(ScopedVisitor):
+    def __init__(self, rule: "BlockingInAsyncRule", module: ParsedModule):
+        super().__init__(module)
+        self.rule = rule
+        self.findings: List[Finding] = []
+        self._imported_time_sleep = False
+
+    def _record(self, kind: str, lineno: int) -> None:
+        self.findings.append(self.rule.finding(
+            self.module.relpath, lineno, self.qualname(), kind, _ADVICE[kind]
+        ))
+
+    def visit_Call(self, node: ast.Call):
+        if self.in_async_function():
+            fn = node.func
+            if isinstance(fn, ast.Attribute):
+                owner = fn.value
+                if isinstance(owner, ast.Name):
+                    if owner.id == "time" and fn.attr == "sleep":
+                        self._record("time-sleep", node.lineno)
+                    elif owner.id == "socket" and fn.attr in _SOCKET_BLOCKING_FUNCS:
+                        self._record("sync-socket", node.lineno)
+                if fn.attr in _PATHLIKE_IO_METHODS:
+                    self._record("blocking-io", node.lineno)
+            elif isinstance(fn, ast.Name):
+                if fn.id == "open":
+                    self._record("blocking-io", node.lineno)
+                elif fn.id == "sleep" and self._imported_time_sleep:
+                    self._record("time-sleep", node.lineno)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        if node.module == "time" and any(alias.name == "sleep" for alias in node.names):
+            self._imported_time_sleep = True
+        self.generic_visit(node)
+
+
+class BlockingInAsyncRule(AstRule):
+    name = "blocking-in-async"
+    title = "no blocking calls inside async def on the swarm loop"
+    rationale = (
+        "ISSUE 8: the event-loop watchdog caught runtime stalls from synchronous calls "
+        "in coroutines (a stalled loop looks like a network straggler to peers); this "
+        "keeps new ones from being written at all."
+    )
+    trees = ("p2p", "dht", "averaging", "moe")
+
+    def check_module(self, module: ParsedModule) -> List[Finding]:
+        visitor = _Visitor(self, module)
+        visitor.visit(module.tree)
+        return visitor.findings

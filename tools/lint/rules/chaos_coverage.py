@@ -1,0 +1,169 @@
+"""Rule ``chaos-coverage``: injection points, the resilience doc, and the soak
+schedule agree — in both directions.
+
+New in ISSUE 16. The chaos layer has three mirrors that historically drifted
+independently: ``resilience/chaos.py`` declares ``INJECTION_POINTS``,
+``docs/resilience.md`` catalogs them for operators, and
+``hivemind_cli/run_chaos_soak.py`` exercises them in ``DEFAULT_SCHEDULE``. A
+point added to the engine but never soaked is untested resilience theater; a
+doc row for a point that no longer exists sends an operator hunting a ghost.
+
+Kinds (point name embedded so allowlisting stays per-point):
+
+- ``undocumented:<point>`` — declared but absent from docs/resilience.md.
+- ``unexercised:<point>`` — declared but absent from DEFAULT_SCHEDULE.
+- ``phantom:<point>``     — soaked but not declared (schedule typo).
+- ``stale-doc:<token>``   — a backticked dotted token in the doc that LOOKS
+  like an injection point (known first segment) but matches none.
+- ``unknown:<literal>``   — a ``CHAOS.inject("...")`` call-site literal that
+  is not a declared point (non-literal first args are skipped; the engine
+  validates those at runtime).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Tuple
+
+from lint.engine import Finding, LintContext, Rule
+
+DOC_PATH = "docs/resilience.md"
+_DOC_TOKEN = re.compile(r"`([a-z0-9_]+(?:\.[a-z0-9_]+)+)`")
+
+
+def _string_tuple(node: ast.AST) -> Optional[List[Tuple[str, int]]]:
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out = []
+    for element in node.elts:
+        if isinstance(element, ast.Constant) and isinstance(element.value, str):
+            out.append((element.value, element.lineno))
+    return out
+
+
+class ChaosCoverageRule(Rule):
+    name = "chaos-coverage"
+    title = "INJECTION_POINTS ↔ docs/resilience.md ↔ DEFAULT_SCHEDULE stay in sync"
+    rationale = (
+        "a chaos point that exists but is never soaked is untested resilience "
+        "theater, and a documented point that no longer exists sends operators "
+        "hunting ghosts — the three mirrors drifted whenever a point was added "
+        "to only one of them."
+    )
+
+    def run(self, ctx: LintContext) -> Tuple[List[Finding], List[str]]:
+        findings: List[Finding] = []
+        warnings: List[str] = []
+
+        chaos_rel = ctx.package_relpath("resilience/chaos.py")
+        chaos = ctx.module(chaos_rel)
+        if chaos is None:
+            return findings, ["chaos-coverage: resilience/chaos.py not found — rule skipped"]
+
+        points: List[Tuple[str, int]] = []
+        for node in chaos.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "INJECTION_POINTS"
+            ):
+                points = _string_tuple(node.value) or []
+        if not points:
+            return findings, ["chaos-coverage: INJECTION_POINTS not found in resilience/chaos.py"]
+        declared = {point for point, _ in points}
+
+        # ---- declared ↔ documented --------------------------------------------
+        doc_text = ctx.read_text(DOC_PATH)
+        if doc_text is None:
+            warnings.append(f"chaos-coverage: {DOC_PATH} not found — doc checks skipped")
+        else:
+            for point, lineno in points:
+                if point not in doc_text:
+                    findings.append(self.finding(
+                        chaos_rel, lineno, "<module>", f"undocumented:{point}",
+                        f"injection point {point!r} is not cataloged in {DOC_PATH}",
+                    ))
+            prefixes = {point.split(".")[0] for point in declared}
+            doc_lines = doc_text.splitlines()
+            seen_tokens = set()
+            for doc_lineno, line in enumerate(doc_lines, start=1):
+                if not line.lstrip().startswith("|"):
+                    continue  # prose may name spans/metrics; only CATALOG rows are the contract
+                for match in _DOC_TOKEN.finditer(line):
+                    token = match.group(1)
+                    if token in seen_tokens or token.split(".")[0] not in prefixes:
+                        continue
+                    seen_tokens.add(token)
+                    # a token may be a point PREFIX used in wildcard-ish prose
+                    # ("state.download") — only exact-looking full points count
+                    if token in declared or any(p.startswith(token + ".") for p in declared):
+                        continue
+                    findings.append(self.finding(
+                        DOC_PATH, doc_lineno, "<doc>", f"stale-doc:{token}",
+                        f"{DOC_PATH} names {token!r} like an injection point but the "
+                        f"engine declares no such point — stale row or typo",
+                    ))
+
+        # ---- declared ↔ soaked ------------------------------------------------
+        soak_rel = ctx.package_relpath("hivemind_cli/run_chaos_soak.py")
+        soak = ctx.module(soak_rel)
+        if soak is None:
+            warnings.append("chaos-coverage: hivemind_cli/run_chaos_soak.py not found — soak checks skipped")
+        else:
+            schedule: List[Tuple[str, int]] = []
+            schedule_lineno = 1
+            for node in soak.tree.body:
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "DEFAULT_SCHEDULE"
+                    and isinstance(node.value, (ast.Tuple, ast.List))
+                ):
+                    schedule_lineno = node.lineno
+                    for entry in node.value.elts:
+                        if (
+                            isinstance(entry, (ast.Tuple, ast.List))
+                            and entry.elts
+                            and isinstance(entry.elts[0], ast.Constant)
+                            and isinstance(entry.elts[0].value, str)
+                        ):
+                            schedule.append((entry.elts[0].value, entry.elts[0].lineno))
+            if not schedule:
+                warnings.append("chaos-coverage: DEFAULT_SCHEDULE not found in run_chaos_soak.py")
+            soaked = {point for point, _ in schedule}
+            for point, lineno in points:
+                if schedule and point not in soaked:
+                    findings.append(self.finding(
+                        soak_rel, schedule_lineno, "<module>", f"unexercised:{point}",
+                        f"injection point {point!r} is declared but DEFAULT_SCHEDULE never "
+                        f"exercises it — the soak proves nothing about it",
+                    ))
+            for point, lineno in schedule:
+                if point not in declared:
+                    findings.append(self.finding(
+                        soak_rel, lineno, "<module>", f"phantom:{point}",
+                        f"DEFAULT_SCHEDULE exercises {point!r} but the engine declares no "
+                        f"such point — the rule silently never fires",
+                    ))
+
+        # ---- call-site literals ------------------------------------------------
+        for module in ctx.modules().values():
+            for node in ast.walk(module.tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "inject"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    literal = node.args[0].value
+                    if literal not in declared:
+                        findings.append(self.finding(
+                            module.relpath, node.lineno, "<module>", f"unknown:{literal}",
+                            f"CHAOS.inject({literal!r}) names an undeclared injection point",
+                        ))
+        return findings, warnings

@@ -1,0 +1,62 @@
+"""Rule ``fire-and-forget``: no dropped ``create_task``/``ensure_future``.
+
+New in ISSUE 16. A task whose handle is thrown away is garbage-collectable
+mid-flight (asyncio keeps only a weak reference) and — worse — swallows its
+exception until interpreter shutdown prints an opaque "Task exception was
+never retrieved". The relay accept-loop and matchmaking key-refresh both
+dropped task handles this way; a crashed accept loop looked like a silent
+relay capacity loss.
+
+Flagged shape (kind ``dropped-task``): an EXPRESSION STATEMENT whose value is
+a ``create_task``/``ensure_future`` call — the handle is neither stored,
+awaited, gathered, nor given a done-callback.
+
+The approved pattern is :func:`hivemind_tpu.utils.asyncio_utils.spawn`,
+which keeps a strong reference, names the task, and logs + counts failures
+(``hivemind_background_task_errors_total{site}``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from lint.engine import AstRule, Finding, ParsedModule, ScopedVisitor
+
+_SPAWNERS = {"create_task", "ensure_future"}
+
+
+class _Visitor(ScopedVisitor):
+    def __init__(self, rule: "FireAndForgetRule", module: ParsedModule):
+        super().__init__(module)
+        self.rule = rule
+        self.findings: List[Finding] = []
+
+    def visit_Expr(self, node: ast.Expr):
+        call = node.value
+        if isinstance(call, ast.Call):
+            fn = call.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else getattr(fn, "id", "")
+            if name in _SPAWNERS:
+                self.findings.append(self.rule.finding(
+                    self.module.relpath, node.lineno, self.qualname(), "dropped-task",
+                    f"{name}(...) result discarded — the task is weakly referenced and its "
+                    f"exception is swallowed; use utils.asyncio_utils.spawn(coro, name=...) "
+                    f"or store the handle and await/cancel it",
+                ))
+        self.generic_visit(node)
+
+
+class FireAndForgetRule(AstRule):
+    name = "fire-and-forget"
+    title = "every spawned task is stored, awaited, or tracked via spawn()"
+    rationale = (
+        "Dropped create_task handles let background loops die silently (relay accept "
+        "loop, matchmaking key refresh): asyncio holds only a weak reference and the "
+        "exception surfaces, if ever, as 'Task exception was never retrieved' at exit."
+    )
+
+    def check_module(self, module: ParsedModule) -> List[Finding]:
+        visitor = _Visitor(self, module)
+        visitor.visit(module.tree)
+        return visitor.findings

@@ -1,0 +1,87 @@
+"""Rule ``hotpath-copies``: keep the averaging AND serving hot paths copy-free.
+
+Ported from tools/check_hotpath_copies.py (ISSUE 6; serving coverage ISSUE 10).
+Scans only the named hot-path files:
+
+- ``bytes-concat`` — a ``+`` whose operand is recognizably bytes: on the frame
+  path this doubles megabyte payloads; use scatter-gather framing.
+- ``copy-astype`` — ``.astype(...)`` without an explicit ``copy=``: astype
+  copies even when the dtype already matches.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from lint.engine import AstRule, Finding, ParsedModule, ScopedVisitor
+
+_BYTES_PRODUCING_METHODS = {"pack", "tobytes", "SerializeToString", "to_bytes"}
+
+
+def _is_bytes_typed(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, bytes):
+        return True
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _BYTES_PRODUCING_METHODS:
+            return True
+        if isinstance(fn, ast.Name) and fn.id == "bytes":
+            return True
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return _is_bytes_typed(node.left) or _is_bytes_typed(node.right)
+    return False
+
+
+class _Visitor(ScopedVisitor):
+    def __init__(self, rule: "HotpathCopiesRule", module: ParsedModule):
+        super().__init__(module)
+        self.rule = rule
+        self.findings: List[Finding] = []
+
+    def visit_BinOp(self, node: ast.BinOp):
+        if isinstance(node.op, ast.Add) and (
+            _is_bytes_typed(node.left) or _is_bytes_typed(node.right)
+        ):
+            self.findings.append(self.rule.finding(
+                self.module.relpath, node.lineno, self.qualname(), "bytes-concat",
+                "pass buffers scatter-gather (send_frame/SecureChannel.send varargs)",
+            ))
+            # one finding per outermost concat chain: do not descend further
+            return
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "astype":
+            if not any(keyword.arg == "copy" for keyword in node.keywords):
+                self.findings.append(self.rule.finding(
+                    self.module.relpath, node.lineno, self.qualname(), "copy-astype",
+                    "spell out astype(..., copy=False) on the hot path",
+                ))
+        self.generic_visit(node)
+
+
+class HotpathCopiesRule(AstRule):
+    name = "hotpath-copies"
+    title = "no byte concats or implicit-copy astype in hot-path files"
+    rationale = (
+        "ISSUE 6/10: per-part byte concats and always-copy astype calls cost ~30% of "
+        "averaging throughput before they were removed; this keeps them out."
+    )
+    files = (
+        "p2p/mux.py",
+        "p2p/crypto_channel.py",
+        "averaging/partition.py",
+        "averaging/allreduce.py",
+        "averaging/residual.py",
+        "compression/quantization.py",
+        "moe/client/expert.py",
+        "moe/server/connection_handler.py",
+        "moe/server/task_pool.py",
+    )
+
+    def check_module(self, module: ParsedModule) -> List[Finding]:
+        visitor = _Visitor(self, module)
+        visitor.visit(module.tree)
+        return visitor.findings

@@ -1,0 +1,98 @@
+"""Rule ``metric-docs``: every registered ``hivemind_*`` metric is documented.
+
+Ported from tools/check_metric_docs.py (ISSUE 9). docs/observability.md is the
+operator's metric catalog and it drifted once (a queue-depth gauge documented
+under a wrong name):
+
+- ``undocumented-metric`` — a ``.counter("hivemind_...")`` / ``.gauge`` /
+  ``.histogram`` registration whose name never appears in the catalog.
+- ``dynamic-metric-name`` — a registry registration whose first argument is
+  not a string literal (uncatalogable).
+
+Stale catalog rows (documented but registered nowhere) are warnings, so the
+catalog shrinks with the code.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from lint.engine import Finding, LintContext, Rule
+
+_REGISTER_METHODS = {"counter", "gauge", "histogram"}
+_DOC_TABLE_NAME = re.compile(r"^\|\s*`(hivemind_[a-z0-9_]+)`")
+
+# documented names that are rendered, not registered (the exporter appends
+# _total to counters / _bucket/_sum/_count to histograms at scrape time)
+_RENDERED_SUFFIXES = ("_total", "_bucket", "_sum", "_count")
+
+DOC_PATH = "docs/observability.md"
+
+
+class MetricDocsRule(Rule):
+    name = "metric-docs"
+    title = "every registered hivemind_* metric appears in docs/observability.md"
+    rationale = (
+        "ISSUE 9: the operator catalog documented a queue-depth gauge under a wrong "
+        "name — a dashboard built from the doc silently read nothing."
+    )
+
+    def run(self, ctx: LintContext) -> Tuple[List[Finding], List[str]]:
+        names: Dict[str, List[Tuple[str, int]]] = {}
+        findings: List[Finding] = []
+        for module in ctx.modules().values():
+            for node in ast.walk(module.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _REGISTER_METHODS
+                    and node.args
+                ):
+                    continue
+                first = node.args[0]
+                if isinstance(first, ast.Constant) and isinstance(first.value, str):
+                    if first.value.startswith("hivemind_"):
+                        names.setdefault(first.value, []).append((module.relpath, node.lineno))
+                elif isinstance(first, ast.Constant):
+                    continue  # literal non-string: not a metric registration
+                else:
+                    # .counter(variable) — only flag when the receiver LOOKS like a
+                    # registry (the watchdog re-registers via <metric>.documentation)
+                    receiver = node.func.value
+                    receiver_name = getattr(receiver, "id", getattr(receiver, "attr", ""))
+                    if str(receiver_name).lower().endswith(("registry", "telemetry")) or (
+                        str(receiver_name) == "REGISTRY"
+                    ):
+                        findings.append(self.finding(
+                            module.relpath, node.lineno, "<module>", "dynamic-metric-name",
+                            f"dynamic metric name in .{node.func.attr}(...): metric names "
+                            f"must be string literals so the catalog lint can see them",
+                        ))
+        doc_text = ctx.read_text(DOC_PATH) or ""
+        for metric_name, sites in sorted(names.items()):
+            if metric_name not in doc_text:
+                relpath, lineno = sites[0]
+                findings.append(self.finding(
+                    relpath, lineno, "<module>", "undocumented-metric",
+                    f"metric {metric_name!r} is not in {DOC_PATH} — add it to the catalog",
+                ))
+        warnings: List[str] = []
+        registered: Set[str] = set(names)
+        table_names = {
+            match.group(1)
+            for line in doc_text.splitlines()
+            for match in [_DOC_TABLE_NAME.match(line.strip())]
+            if match is not None
+        }
+        for doc_name in sorted(table_names):
+            candidates = {doc_name} | {
+                doc_name[: -len(suffix)] for suffix in _RENDERED_SUFFIXES if doc_name.endswith(suffix)
+            }
+            if not candidates & registered:
+                warnings.append(
+                    f"{DOC_PATH} catalogs {doc_name!r} but nothing registers it "
+                    f"(stale entry or typo'd name — the drift this rule exists to catch)"
+                )
+        return findings, warnings

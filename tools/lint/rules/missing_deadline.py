@@ -1,0 +1,114 @@
+"""Rule ``missing-deadline``: network-layer awaits must be reachable from a
+deadline.
+
+New in ISSUE 16. An RPC await with no timeout anywhere in scope hangs forever
+when the remote peer stalls instead of dying — the replication state fetch did
+exactly this: it ACCEPTED a ``chunk_timeout`` parameter and then never applied
+it, so a stalled donor wedged the fetch coroutine permanently.
+
+Flagged shape (kind ``no-deadline``): a call to a network primitive
+(``call_protobuf_handler`` / ``iterate_protobuf_handler``) inside a function
+whose body shows NO deadline machinery at all. "Deadline machinery" is any of:
+
+- a ``timeout=``/``deadline=``-style keyword on some call in the body,
+- a load of a name or attribute matching ``*timeout*``/``*deadline*``,
+- a call to ``asyncio.wait_for`` / ``aiter_with_timeout``.
+
+Deliberately coarse: one timeout mention anywhere in the body clears the whole
+function. That keeps false positives near zero while still catching the real
+bug class — a *signature* parameter alone does NOT count (an accepted-but-
+unused ``chunk_timeout`` is precisely the defect this rule exists to find).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Sequence, Tuple
+
+from lint.engine import AstRule, Finding, ParsedModule
+
+_NETWORK_CALLS = {"call_protobuf_handler", "iterate_protobuf_handler"}
+_DEADLINE_NAME = re.compile(r"timeout|deadline", re.IGNORECASE)
+_DEADLINE_FUNCS = {"wait_for", "aiter_with_timeout"}
+
+_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+
+def _call_name(node: ast.Call) -> str:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def _walk_own_body(stmts: Sequence[ast.stmt]) -> Iterator[ast.AST]:
+    """Every node in these statements EXCLUDING nested def/class subtrees
+    (they get their own deadline scope and their own findings)."""
+    stack: List[ast.AST] = list(stmts)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, _DEFS):
+            continue  # yielded so the caller records it, but never entered
+        for child in ast.iter_child_nodes(node):
+            stack.append(child)
+
+
+class MissingDeadlineRule(AstRule):
+    name = "missing-deadline"
+    title = "network RPC awaits are reachable from a timeout"
+    rationale = (
+        "replication.fetch_replica_state accepted chunk_timeout and never used it — a "
+        "stalled donor wedged the fetch forever. Peers fail by stalling, not only by "
+        "dying; every network await needs a deadline in scope."
+    )
+
+    def check_module(self, module: ParsedModule) -> List[Finding]:
+        findings: List[Finding] = []
+
+        def check_function(func: ast.AST, qualname: str) -> None:
+            nested: List[Tuple[ast.AST, str]] = []
+            network_calls: List[ast.Call] = []
+            has_deadline = False
+            for node in _walk_own_body(func.body):
+                if isinstance(node, _DEFS):
+                    nested.append((node, f"{qualname}.{node.name}"))
+                    continue
+                if isinstance(node, ast.Name) and _DEADLINE_NAME.search(node.id):
+                    has_deadline = True
+                elif isinstance(node, ast.Attribute) and _DEADLINE_NAME.search(node.attr):
+                    has_deadline = True
+                elif isinstance(node, ast.keyword) and node.arg and _DEADLINE_NAME.search(node.arg):
+                    has_deadline = True
+                elif isinstance(node, ast.Call):
+                    name = _call_name(node)
+                    if name in _DEADLINE_FUNCS:
+                        has_deadline = True
+                    elif name in _NETWORK_CALLS:
+                        network_calls.append(node)
+            if not has_deadline:
+                for call in network_calls:
+                    findings.append(self.finding(
+                        module.relpath, call.lineno, qualname, "no-deadline",
+                        f"{_call_name(call)}(...) with no timeout anywhere in "
+                        f"{qualname} — wrap in asyncio.wait_for / pass a timeout so a "
+                        f"stalled peer cannot wedge this coroutine",
+                    ))
+            for sub, sub_qualname in nested:
+                descend(sub, sub_qualname)
+
+        def descend(node: ast.AST, qualname: str) -> None:
+            if isinstance(node, ast.ClassDef):
+                for child in node.body:
+                    if isinstance(child, _DEFS):
+                        descend(child, f"{qualname}.{child.name}")
+            else:
+                check_function(node, qualname)
+
+        for top in module.tree.body:
+            if isinstance(top, _DEFS):
+                descend(top, top.name)
+        return findings

@@ -1,0 +1,194 @@
+"""Rule ``wire-drift``: the checked-in wire schema matches its generators.
+
+New in ISSUE 16. Two drift axes, both of which corrupt frames silently:
+
+- ``regen-pending`` / ``regen-drift`` — ``tools/regen_proto.py`` evolves the
+  FileDescriptorProto and re-renders each ``proto/*_pb2.py``; if evolving the
+  checked-in blob would change it, or re-rendering does not reproduce the
+  checked-in module byte-for-byte, someone hand-edited a ``_pb2`` or forgot to
+  commit a regen. Peers then disagree about the schema revision they claim.
+- ``tag-drift`` / ``tag-unverifiable`` — compression/serialization.py
+  hand-rolls protobuf field tags (``_TENSOR_BUFFER_TAG = b"\\x0a"``) for the
+  zero-copy fast path. Each constant carries a ``# Message.field = N`` comment;
+  this rule recomputes ``varint((N << 3) | wire_type)`` from the real
+  descriptor and fails on any mismatch — renumbering a proto field without
+  updating the fast path would otherwise ship frames the slow path cannot
+  parse.
+
+Pure-descriptor work: extracts the ``AddSerializedFile(b"...")`` blob from the
+``_pb2`` AST, so nothing heavyweight (jax, the package itself) is imported.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import re
+from typing import Dict, List, Optional, Tuple
+
+from lint.engine import Finding, LintContext, ParsedModule, Rule
+
+_TAG_CONST = re.compile(r"^_[A-Z0-9_]*TAG$")
+_TAG_COMMENT = re.compile(r"#\s*(\w+)\.(\w+)\s*=\s*(\d+)")
+
+# FieldDescriptorProto.Type -> proto wire type
+_WIRETYPE = {
+    1: 1, 2: 5, 3: 0, 4: 0, 5: 0, 6: 1, 7: 5, 8: 0, 9: 2, 10: 3,
+    11: 2, 12: 2, 13: 0, 14: 0, 15: 5, 16: 1, 17: 0, 18: 0,
+}
+
+
+def _varint(value: int) -> bytes:
+    out = bytearray()
+    while True:
+        byte = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(byte | 0x80)
+        else:
+            out.append(byte)
+            return bytes(out)
+
+
+def _serialized_blob(module: ParsedModule) -> Tuple[Optional[bytes], int]:
+    for node in ast.walk(module.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "AddSerializedFile"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, bytes)
+        ):
+            return node.args[0].value, node.lineno
+    return None, 0
+
+
+def _load_regen_proto(ctx: LintContext):
+    path = ctx.repo_root / "tools" / "regen_proto.py"
+    if not path.is_file():
+        return None
+    spec = importlib.util.spec_from_file_location("_hivemind_lint_regen_proto", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+class WireDriftRule(Rule):
+    name = "wire-drift"
+    title = "checked-in _pb2 modules and hand-rolled field tags match the schema"
+    rationale = (
+        "the serialization fast path writes protobuf tags by hand for zero-copy "
+        "framing; a field renumbered in the .proto without updating the constants "
+        "ships frames the canonical parser rejects — and a hand-edited _pb2 makes "
+        "peers disagree about the schema revision. Both drifts are invisible to "
+        "unit tests that encode and decode with the same build."
+    )
+
+    def run(self, ctx: LintContext) -> Tuple[List[Finding], List[str]]:
+        findings: List[Finding] = []
+        warnings: List[str] = []
+        try:
+            from google.protobuf import descriptor_pb2
+        except ImportError:
+            return findings, ["wire-drift: google.protobuf unavailable — rule skipped"]
+
+        # ---- collect every checked-in descriptor -------------------------------
+        proto_modules: List[Tuple[ParsedModule, bytes, int]] = []
+        for relpath, module in sorted(ctx.modules().items()):
+            if not module.path.name.endswith("_pb2.py"):
+                continue
+            blob, lineno = _serialized_blob(module)
+            if blob is None:
+                warnings.append(f"wire-drift: no AddSerializedFile blob in {relpath} — skipped")
+                continue
+            proto_modules.append((module, blob, lineno))
+
+        # ---- regen idempotence -------------------------------------------------
+        regen = _load_regen_proto(ctx)
+        if regen is None:
+            if proto_modules:
+                warnings.append("wire-drift: tools/regen_proto.py missing — idempotence check skipped")
+        else:
+            for module, blob, lineno in proto_modules:
+                if module.path.stem != "averaging_pb2":
+                    continue  # regen_proto regenerates only the averaging schema
+                file_proto = descriptor_pb2.FileDescriptorProto.FromString(blob)
+                changed = regen.evolve(file_proto)
+                if changed:
+                    findings.append(self.finding(
+                        module.relpath, lineno, "<module>", "regen-pending",
+                        f"regen_proto.evolve would change {changed} thing(s) — the "
+                        f"checked-in descriptor lags the generator; rerun tools/regen_proto.py",
+                    ))
+                    continue
+                stem = module.path.stem  # e.g. "averaging_pb2"
+                module_name = f"{ctx.package_root.name}.proto.{stem}"
+                rendered = regen.render_pb2(
+                    descriptor_pb2.FileDescriptorProto.FromString(blob), module_name
+                )
+                if rendered != module.source:
+                    findings.append(self.finding(
+                        module.relpath, lineno, "<module>", "regen-drift",
+                        f"re-rendering the descriptor does not reproduce {module.relpath} "
+                        f"byte-for-byte — hand-edited _pb2 or stale regen; rerun tools/regen_proto.py",
+                    ))
+
+        # ---- hand-rolled tag constants ----------------------------------------
+        serialization = ctx.module(ctx.package_relpath("compression/serialization.py"))
+        if serialization is None:
+            return findings, warnings
+
+        fields: Dict[str, Dict[str, object]] = {}
+
+        def collect(message) -> None:
+            fields.setdefault(message.name, {})
+            for field in message.field:
+                fields[message.name][field.name] = field
+            for nested in message.nested_type:
+                collect(nested)
+
+        for _, blob, _ in proto_modules:
+            file_proto = descriptor_pb2.FileDescriptorProto.FromString(blob)
+            for message in file_proto.message_type:
+                collect(message)
+
+        for node in serialization.tree.body:
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and _TAG_CONST.match(node.targets[0].id)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, bytes)
+            ):
+                continue
+            const_name = node.targets[0].id
+            tag_bytes = node.value.value
+            line = serialization.lines[node.lineno - 1]
+            match = _TAG_COMMENT.search(line)
+            if match is None:
+                findings.append(self.finding(
+                    serialization.relpath, node.lineno, "<module>", "tag-unverifiable",
+                    f"{const_name} has no `# Message.field = N` comment — the lint "
+                    f"cannot tie this wire tag to a proto field; annotate it",
+                ))
+                continue
+            message_name, field_name, claimed_number = match.group(1), match.group(2), int(match.group(3))
+            field = fields.get(message_name, {}).get(field_name)
+            if field is None:
+                findings.append(self.finding(
+                    serialization.relpath, node.lineno, "<module>", "tag-drift",
+                    f"{const_name} claims {message_name}.{field_name} but no such field "
+                    f"exists in the checked-in descriptors",
+                ))
+                continue
+            expected = _varint((field.number << 3) | _WIRETYPE[field.type])
+            if field.number != claimed_number or tag_bytes != expected:
+                findings.append(self.finding(
+                    serialization.relpath, node.lineno, "<module>", "tag-drift",
+                    f"{const_name} = {tag_bytes!r} but {message_name}.{field_name} is "
+                    f"field {field.number} (wire type {_WIRETYPE[field.type]}) — "
+                    f"expected {expected!r}; the fast path would ship unparseable frames",
+                ))
+        return findings, warnings
