@@ -576,6 +576,10 @@ def main() -> None:
         "recall_at_beam": swarm_extra.get("recall_at_beam"),
         "deterministic": swarm_extra.get("deterministic"),
         "get_success_rate": swarm_extra.get("get_success_rate"),
+        # virtual-time round-ledger summary (ISSUE 17): round totals and
+        # straggler attribution aggregated from the sim's synthesized
+        # allreduce spans — part of the determinism digest above
+        "ledger": swarm_extra.get("ledger"),
         # the driver prints its JSON line before exiting nonzero on a breached
         # invariant — without this list a failed soak would read as clean data
         "failures": swarm_extra.get("failures"),

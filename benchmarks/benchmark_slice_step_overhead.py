@@ -25,6 +25,10 @@ def main():
     parser.add_argument("--num_devices", type=int, default=8)
     parser.add_argument("--steps", type=int, default=400)
     parser.add_argument("--max_broadcast_skip", type=int, default=8)
+    parser.add_argument("--no_blackbox", action="store_true",
+                        help="skip the spool-armed measurement (ISSUE 17: the "
+                             "black-box recorder must not move the hot path "
+                             "out of its band)")
     from hivemind_tpu.utils.platform import add_platform_arg, apply_platform
 
     add_platform_arg(parser)
@@ -81,6 +85,23 @@ def main():
 
     with_broadcast = measure(0)
     thinned = measure(args.max_broadcast_skip)
+    spooled = None
+    if not args.no_blackbox:
+        # same hot path with the flight recorder armed: span finishes now fan
+        # out to the spool writer's listener. The append is a buffered msgpack
+        # pack + flush off the span's own lock, so the step stays in-band.
+        import tempfile
+
+        from hivemind_tpu.telemetry.blackbox import arm_blackbox, disarm_blackbox, read_spool
+
+        with tempfile.TemporaryDirectory(prefix="slice_step_spool_") as spool_dir:
+            arm_blackbox(spool_dir, peer="bench", metrics_interval=None)
+            try:
+                spooled = measure(0)
+            finally:
+                disarm_blackbox()
+            _, spool_stats = read_spool(spool_dir)
+            spooled["spool_frames"] = spool_stats["frames"]
     print(json.dumps({
         "metric": "slice_step_decision_overhead_us",
         "value": with_broadcast["us_per_step"],
@@ -88,6 +109,8 @@ def main():
         "extra": {
             "thinned_us_per_step": thinned["us_per_step"],
             "thinned_skipped_fraction": thinned["skipped_fraction"],
+            "spooled_us_per_step": (spooled or {}).get("us_per_step"),
+            "spool_frames": (spooled or {}).get("spool_frames"),
             "max_broadcast_skip": args.max_broadcast_skip,
             "num_devices": args.num_devices,
             "steps": args.steps,

@@ -125,10 +125,17 @@ class DecentralizedAverager(ServicerBase):
         state_compression: Optional[CompressionBase] = None,
         declare_state_period: float = 30.0,
         shutdown_timeout: float = 5.0,
+        blackbox_dir: Optional[Any] = None,
         loop_runner: Optional[LoopRunner] = None,
     ):
         assert "." not in prefix, "prefix may not contain '.'"
         self.dht = dht
+        if blackbox_dir is not None:
+            # crash-durable flight recorder (docs/observability.md): arm the
+            # process-wide spool before the first round; idempotent per directory
+            from hivemind_tpu.telemetry.blackbox import arm_blackbox
+
+            arm_blackbox(blackbox_dir, peer=str(dht.peer_id))
         self.prefix = prefix
         self.client_mode, self.auxiliary = client_mode, auxiliary
         self.mode = (

@@ -37,10 +37,12 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from hivemind_tpu.hivemind_cli.run_blackbox import reconstruct_final_round
 from hivemind_tpu.resilience import CHAOS, INJECTION_POINTS, reset_all_boards
 from hivemind_tpu.telemetry import REGISTRY
+from hivemind_tpu.telemetry.blackbox import BlackBox, read_spool
 from hivemind_tpu.telemetry.ledger import LEDGER
-from hivemind_tpu.telemetry.tracing import RECORDER
+from hivemind_tpu.telemetry.tracing import RECORDER, thread_current_span
 from hivemind_tpu.telemetry.watchdog import watchdog_summary
 from hivemind_tpu.utils.logging import get_logger
 
@@ -103,6 +105,7 @@ def run_soak(
     churn: bool = False,
     churn_kills: Optional[int] = None,
     checkpoint_root: Optional[str] = None,
+    blackbox_root: Optional[str] = None,
 ) -> dict:
     """Run the soak; returns a JSON-able report with an ``ok`` verdict.
 
@@ -110,6 +113,14 @@ def run_soak(
     never peer 0, which anchors the DHT bootstrap and the download prober) are
     crash-killed on a seeded schedule inside the chaos window and restarted a few
     seconds later with the same local checkpoint directory.
+
+    Every peer writes a black-box spool under ``blackbox_root`` (ISSUE 17;
+    defaults to a tempdir when churn is on). A churn kill abandons the
+    victim's spool exactly as a kill-9 would — active segment unpublished,
+    torn tail and all — and the verdict then also requires
+    ``postmortem_reconstructed``: the victim's final round and its last
+    in-flight span rebuilt from that spool by the ``hivemind-blackbox``
+    machinery.
     """
     import random as random_module
 
@@ -157,6 +168,10 @@ def run_soak(
     if churn and checkpoint_root is None:
         checkpoint_dir_ctx = tempfile.TemporaryDirectory(prefix="chaos_soak_ckpt_")
         checkpoint_root = checkpoint_dir_ctx.name
+    blackbox_dir_ctx = None
+    if churn and blackbox_root is None:
+        blackbox_dir_ctx = tempfile.TemporaryDirectory(prefix="chaos_soak_blackbox_")
+        blackbox_root = blackbox_dir_ctx.name
 
     server = None
     moe_stats = {"ok_during": 0, "ok_after": 0, "calls": 0}
@@ -167,17 +182,29 @@ def run_soak(
     epochs: Dict[int, int] = {index: 0 for index in range(n_peers)}
 
     class _TrainerSlot:
-        def __init__(self, index: int, dht: DHT):
+        def __init__(self, index: int, dht: DHT, restarts: int = 0):
             self.index = index
             self.dht = dht
             self.kill = threading.Event()  # crash simulation: NO clean shutdown
             self.opt = None
             self.thread: Optional[threading.Thread] = None
-            self.restarts = 0
+            self.restarts = restarts
+            self.box: Optional[BlackBox] = None
+            self.spool_dir: Optional[str] = None
+            if blackbox_root is not None:
+                # one spool per peer INCARNATION: a restart writes a fresh
+                # directory, so the dead incarnation's spool stays exactly as
+                # the crash left it (the post-mortem's evidence)
+                suffix = f"-r{restarts}" if restarts else ""
+                self.spool_dir = f"{blackbox_root}/peer{index}{suffix}"
+                self.box = BlackBox(
+                    self.spool_dir, peer=f"peer{index}", peer_filter=str(dht.peer_id)
+                )
 
     slots: Dict[int, _TrainerSlot] = {index: _TrainerSlot(index, dht) for index, dht in enumerate(dhts)}
     dead_peer_ids: List[str] = []  # breakers for these ids legitimately stay open
     retired_threads: List[threading.Thread] = []  # crash-killed trainers, still joined at exit
+    victim_spools: List[Dict[str, object]] = []  # abandoned spool dirs, one per kill
 
     features, targets, loss_and_grad = _toy_problem(seed)
 
@@ -333,13 +360,34 @@ def run_soak(
                 continue  # keep a quorum able to form groups
             index = rng.choice(candidates)
             slot = slots[index]
+            # die MID-OPERATION when possible: wait (bounded) until the victim's
+            # trainer thread has a span open, so the abandoned spool holds a
+            # span_start with no finish — the post-mortem's "died inside this
+            # operation" evidence (a real crash overwhelmingly lands mid-step;
+            # the 0.25 s inter-step sleep is the only quiet window)
+            mid_span_deadline = time.monotonic() + 5.0
+            while (
+                time.monotonic() < mid_span_deadline
+                and not stop_event.is_set()
+                and (slot.thread is None or thread_current_span(slot.thread.ident) is None)
+            ):
+                time.sleep(0.05)
             logger.warning(f"churn: crash-killing trainer {index}")
             slot.kill.set()
+            victim_peer_id = None
             try:
-                dead_peer_ids.append(str(slot.dht.peer_id))
+                victim_peer_id = str(slot.dht.peer_id)  # unreadable once shut down
+                dead_peer_ids.append(victim_peer_id)
                 slot.dht.shutdown()  # the "power cord": transport dies instantly
             except Exception as e:
                 logger.debug(f"churn kill {index}: {e!r}")
+            if slot.box is not None:
+                # kill-9 the spool too: unsubscribe without publishing — the
+                # .open segment stays on disk exactly as the dead peer left it
+                slot.box.abandon()
+                victim_spools.append(
+                    {"index": index, "dir": slot.spool_dir, "peer_id": victim_peer_id}
+                )
             if stop_event.wait(rng.uniform(2.0, 4.0)):
                 return
             logger.warning(f"churn: restarting trainer {index}")
@@ -352,8 +400,7 @@ def run_soak(
                 if not stop_event.is_set():
                     errors.append(f"churn restart {index}: could not rejoin the swarm")
                 continue
-            new_slot = _TrainerSlot(index, new_dht)
-            new_slot.restarts = slot.restarts + 1
+            new_slot = _TrainerSlot(index, new_dht, restarts=slot.restarts + 1)
             if slot.thread is not None:
                 retired_threads.append(slot.thread)
             slots[index] = new_slot
@@ -473,6 +520,8 @@ def run_soak(
             if server is not None:
                 server.shutdown()
             for slot in slots.values():
+                if slot.box is not None:
+                    slot.box.close()  # survivors publish cleanly; victims were abandoned
                 if not slot.kill.is_set():
                     slot.dht.shutdown()
 
@@ -523,6 +572,38 @@ def run_soak(
         report["watchdog_stalls_while_disarmed"] = stalls_while_disarmed
         report["ledger_summary"] = LEDGER.summary()
 
+        # post-mortem (ISSUE 17): every kill -9'd victim left an unpublished
+        # ``.open`` spool behind; rebuild its final round from the corpse with
+        # the same reader hivemind-blackbox uses. Reconstruction must name the
+        # span the victim died inside — a spool that only shows cleanly
+        # finished work means the recorder was not crash-durable.
+        postmortems: Dict[str, Dict[str, object]] = {}
+        for entry in victim_spools:
+            spool_dir = str(entry["dir"])
+            try:
+                frames, spool_stats = read_spool(spool_dir)
+                post = reconstruct_final_round(frames, spool_stats)
+            except Exception as exc:  # a corrupt corpse is a finding, not a crash
+                postmortems[spool_dir] = {"error": repr(exc), "reconstructed": False}
+                continue
+            final_round = post.get("final_round") or {}
+            in_flight = post.get("last_in_flight") or {}
+            postmortems[spool_dir] = {
+                "peer": f"peer{entry['index']}",
+                "frames": spool_stats.get("frames", 0),
+                "torn_tail": spool_stats.get("torn_tail", 0),
+                "corrupt": spool_stats.get("corrupt", 0),
+                "final_round": final_round.get("round"),
+                "final_round_slowest": final_round.get("slowest_peer"),
+                "last_in_flight_span": in_flight.get("name"),
+                "open_spans": post.get("open_spans", 0),
+                "reconstructed": bool(post.get("reconstructed"))
+                and in_flight.get("name") is not None,
+            }
+        report["postmortems"] = postmortems
+        if blackbox_root is not None:
+            report["blackbox_root"] = blackbox_root
+
         report.update(
             steps=dict(step_counts),
             steps_after_chaos=steps_after_chaos,
@@ -563,6 +644,11 @@ def run_soak(
         if churn:
             checks["peers_restarted"] = bool(restart_report)
             checks["state_recovered"] = bool(report["state_recovered"]) and bool(restart_report)
+            # the flight-recorder loop closed: at least one victim's final
+            # round AND its dying in-flight span came back out of the spool
+            checks["postmortem_reconstructed"] = bool(postmortems) and any(
+                entry.get("reconstructed") for entry in postmortems.values()
+            )
         report["checks"] = checks
         report["ok"] = all(checks.values())
         return report
@@ -575,6 +661,8 @@ def run_soak(
         reset_all_boards()
         if checkpoint_dir_ctx is not None:
             checkpoint_dir_ctx.cleanup()
+        if blackbox_dir_ctx is not None:
+            blackbox_dir_ctx.cleanup()
 
 
 def run_serving_churn(
@@ -796,6 +884,9 @@ def main() -> None:
                         help="how many kill/restart cycles (default: peers // 3, min 1)")
     parser.add_argument("--checkpoint-root", default=None,
                         help="directory for per-peer crash-safe checkpoints (default: a tempdir)")
+    parser.add_argument("--blackbox-root", default=None,
+                        help="directory for per-peer black-box spools (default: a tempdir under "
+                             "--churn; pass a path to keep victim spools for hivemind-blackbox)")
     parser.add_argument("--spec", default=None,
                         help="HIVEMIND_CHAOS-grammar schedule overriding the default")
     parser.add_argument("--serving", action="store_true",
@@ -813,6 +904,7 @@ def main() -> None:
         n_peers=args.peers, duration=args.duration, seed=args.seed,
         chaos_fraction=args.chaos_fraction, include_moe=not args.no_moe, spec=args.spec,
         churn=args.churn, churn_kills=args.churn_kills, checkpoint_root=args.checkpoint_root,
+        blackbox_root=args.blackbox_root,
     )
     print(json.dumps(report, indent=2, default=str))
     sys.exit(0 if report["ok"] else 1)

@@ -35,6 +35,12 @@ def main():
                         help="publish this peer's telemetry snapshot to the DHT "
                              "under this key every --refresh_period seconds "
                              "(see docs/observability.md)")
+    parser.add_argument("--blackbox_dir", default=None,
+                        help="crash-durable flight-recorder spool directory: "
+                             "finished spans, ledger records and metric "
+                             "snapshots are appended as msgpack frames readable "
+                             "post-mortem with hivemind-blackbox (see "
+                             "docs/observability.md 'Black-box flight recorder')")
     from hivemind_tpu.utils.platform import add_platform_arg, apply_platform
 
     add_platform_arg(parser)
@@ -53,6 +59,13 @@ def main():
     for maddr in dht.get_visible_maddrs():
         logger.info(f"listening: {maddr}")
     logger.info(f"to join this swarm: --initial_peers {dht.get_visible_maddrs()[0]}")
+
+    blackbox = None
+    if args.blackbox_dir:
+        from hivemind_tpu.telemetry.blackbox import arm_blackbox
+
+        blackbox = arm_blackbox(args.blackbox_dir, peer=str(dht.peer_id))
+        logger.info(f"black-box recorder armed: spooling to {args.blackbox_dir}")
 
     # the DHT armed the event-loop watchdog on its loop; asserting here keeps
     # the CLI loud if the kill switch (HIVEMIND_WATCHDOG=0) disabled it
@@ -92,6 +105,10 @@ def main():
             publisher.shutdown()
         if exporter is not None:
             exporter.shutdown()
+        if blackbox is not None:
+            from hivemind_tpu.telemetry.blackbox import disarm_blackbox
+
+            disarm_blackbox()
         dht.shutdown()
 
 

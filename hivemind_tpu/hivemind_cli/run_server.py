@@ -106,6 +106,12 @@ def main():
                         help="publish this server's telemetry snapshot to the DHT "
                              "under this key every --telemetry_interval seconds")
     parser.add_argument("--telemetry_interval", type=float, default=30.0)
+    parser.add_argument("--blackbox_dir", default=None,
+                        help="crash-durable flight-recorder spool directory: "
+                             "finished spans, round/serving ledger records and "
+                             "metric snapshots are appended as msgpack frames "
+                             "readable post-mortem with hivemind-blackbox (see "
+                             "docs/observability.md 'Black-box flight recorder')")
     from hivemind_tpu.utils.platform import add_platform_arg, apply_platform
 
     add_platform_arg(parser)
@@ -271,6 +277,16 @@ def _start_telemetry(args, dht):
     if ensure_watchdog(get_loop_runner().loop) is None:
         logger.warning("event-loop watchdog disabled (HIVEMIND_WATCHDOG=0): stalls will be silent")
     components = []
+    if getattr(args, "blackbox_dir", None):
+        import types
+
+        from hivemind_tpu.telemetry.blackbox import arm_blackbox, disarm_blackbox
+
+        arm_blackbox(args.blackbox_dir, peer=str(dht.peer_id))
+        logger.info(f"black-box recorder armed: spooling to {args.blackbox_dir}")
+        # disarm (not just close) at shutdown so the global slot is freed for
+        # whatever arms next in this process
+        components.append(types.SimpleNamespace(shutdown=disarm_blackbox))
     if args.metrics_port is not None:
         from hivemind_tpu.telemetry import MetricsExporter
 

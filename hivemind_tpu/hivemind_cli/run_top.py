@@ -330,7 +330,32 @@ def main() -> None:
     parser.add_argument("--serving", action="store_true",
                         help="append the serving board: per-expert QPS/p95/sheds, "
                              "saturation, scorecards, slowest-request exemplars")
+    parser.add_argument("--from-spool", nargs="+", default=None, dest="from_spool",
+                        metavar="DIR",
+                        help="replay mode for dead swarms: render one frame from "
+                             "black-box spool directories (no DHT) and exit")
     args = parser.parse_args()
+
+    if args.from_spool:
+        # post-mortem replay (ISSUE 17): the dashboard over spools a dead
+        # swarm left behind — a pure reader of the on-disk frames
+        from pathlib import Path
+
+        from hivemind_tpu.hivemind_cli.run_blackbox import load_spools, spool_snapshot
+
+        spools = load_spools([Path(d) for d in args.from_spool])
+        records = {peer: spool_snapshot(spool) for peer, spool in spools.items()}
+        newest = max(
+            (snapshot.get("time", 0.0) for snapshot in records.values()), default=0.0
+        )
+        frame, _ = render_frame(
+            records,
+            publish_interval=args.publish_interval,
+            now=newest or None,
+            ansi=not args.no_ansi,
+        )
+        print(frame, flush=True)
+        return
 
     from hivemind_tpu.dht import DHT
     from hivemind_tpu.telemetry.monitor import DEFAULT_TELEMETRY_KEY, fetch_swarm_telemetry

@@ -86,6 +86,11 @@ class Optimizer(ChronicFailureTracking):
         fresh; docs/state_recovery.md)
     :param checkpoint_every: save every N epochs (default 1)
     :param checkpoint_keep_last: checkpoints retained after every save (default 3)
+    :param blackbox_dir: when set, arm the process-wide black-box flight
+        recorder spooling to this directory (crash-durable msgpack frames of
+        finished spans, ledger records and metric snapshots; read post-mortem
+        with ``hivemind-blackbox`` — docs/observability.md). Arming is
+        idempotent per directory, so run_server and Optimizer can both pass it.
     """
 
     def __init__(
@@ -121,6 +126,7 @@ class Optimizer(ChronicFailureTracking):
         checkpoint_dir: Optional[Any] = None,
         checkpoint_every: int = 1,
         checkpoint_keep_last: int = 3,
+        blackbox_dir: Optional[Any] = None,
         verbose: bool = False,
     ):
         assert not (client_mode and auxiliary), "a peer is either a client or an auxiliary, not both"
@@ -157,6 +163,12 @@ class Optimizer(ChronicFailureTracking):
         # (a persistently failing swarm must not silently train local SGD forever)
         self.chronic_failure_threshold = chronic_failure_threshold
         self._consecutive_failed_rounds = 0
+        if blackbox_dir is not None:
+            # arm BEFORE the averagers spin up so their first rounds spool too;
+            # idempotent per directory (see arm_blackbox)
+            from hivemind_tpu.telemetry.blackbox import arm_blackbox
+
+            arm_blackbox(blackbox_dir, peer=str(dht.peer_id))
 
         averager_common = dict(
             target_group_size=target_group_size,

@@ -22,6 +22,7 @@ import asyncio
 import math
 from typing import Optional
 
+from hivemind_tpu.telemetry.tracing import set_telemetry_time_source
 from hivemind_tpu.utils.timed_storage import set_dht_time_source
 
 # consecutive selector polls with nothing scheduled and nothing ready before the
@@ -91,10 +92,16 @@ class VirtualClockEventLoop(asyncio.SelectorEventLoop):
 
 
 def install_virtual_time(loop: VirtualClockEventLoop) -> None:
-    """Point ``get_dht_time`` at the loop's virtual clock."""
+    """Point ``get_dht_time`` AND the telemetry clock (spans, ledgers,
+    watchdog stamps, black-box spools — ISSUE 17) at the loop's virtual
+    clock. Virtual time starts at an epoch magnitude, so it serves as both
+    the span clock and the wall clock; the wall anchor is exactly 0 and
+    same-seed runs spool bit-identical telemetry."""
     set_dht_time_source(loop.time)
+    set_telemetry_time_source(loop.time)
 
 
 def uninstall_virtual_time() -> None:
     """Restore wall-clock swarm time (always call from a finally block)."""
     set_dht_time_source(None)
+    set_telemetry_time_source(None)

@@ -30,6 +30,7 @@ from hivemind_tpu.p2p import P2PContext, PeerID
 from hivemind_tpu.p2p.servicer import ServicerBase
 from hivemind_tpu.proto import averaging_pb2
 from hivemind_tpu.sim.network import SimNetwork, SimP2P
+from hivemind_tpu.telemetry.tracing import trace
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.serializer import MSGPackSerializer
 from hivemind_tpu.utils.tensor_descr import TensorDescriptor
@@ -164,6 +165,53 @@ class SimPeer:
     async def look_for_group(self, *, timeout: Optional[float] = None):
         assert self.matchmaking is not None, "call enable_matchmaking() first"
         return await self.matchmaking.look_for_group(data_for_gather=b"", timeout=timeout)
+
+    async def simulate_allreduce_round(
+        self,
+        group,
+        *,
+        descriptors: Sequence[TensorDescriptor] = DEFAULT_SIM_DESCRIPTORS,
+        reduce_throughput: float = 2e9,
+    ) -> None:
+        """Synthesize one butterfly all-reduce round as REAL telemetry spans
+        (ISSUE 17): an ``allreduce.round`` span wrapping ``local_reduce`` plus
+        one ``peer_exchange`` per partner, with durations derived from the
+        seeded :class:`~hivemind_tpu.sim.network.LinkMatrix` — so the round
+        ledger and the black-box spool see the same span shapes a live
+        averager emits, in virtual time, bit-identically per seed. No tensor
+        math runs: the sleeps ARE the data plane here.
+        """
+        peer_ids = list(group.peer_ids)
+        # rank by canonical member order, NOT leader order: the leader shuffles
+        # with an os.urandom group id (real protocol, deliberately unseeded),
+        # and same-seed sim runs must spool bit-identical ledger records
+        canonical = sorted(peer_ids, key=str)
+        rank = canonical.index(self.peer_id) if self.peer_id in canonical else -1
+        total_bytes = sum(d.nbytes for d in descriptors)
+        # butterfly all-reduce: each peer owns 1/group_size of the vector and
+        # exchanges its part with every partner
+        part_bytes = total_bytes / max(1, len(peer_ids))
+
+        async def _exchange(remote_id: PeerID) -> None:
+            remote = self.network.get_peer(remote_id)
+            remote_name = remote.name if remote is not None else str(remote_id)
+            remote_region = remote.region if remote is not None else self.region
+            spec = self.network.links.spec(self.name, remote_name, self.region, remote_region)
+            with trace("allreduce.peer_exchange", peer=self.name, remote=remote_name):
+                await asyncio.sleep(spec.delay + part_bytes / spec.bandwidth)
+
+        with trace(
+            "allreduce.round", peer=self.name, group_size=len(peer_ids), rank=rank
+        ):
+            with trace("allreduce.local_reduce", peer=self.name):
+                await asyncio.sleep(total_bytes / reduce_throughput)
+            remotes = [pid for pid in peer_ids if pid != self.peer_id]
+            # sequential, not gathered: the task-interleave order of concurrent
+            # sleeps would depend on sibling peers sharing the loop, and the
+            # ledger's late-exchange path is already exercised by live tests.
+            # Virtual time makes the sequential walk free.
+            for remote_id in remotes:
+                await _exchange(remote_id)
 
     # ------------------------------------------------------------------ lifecycle
 

@@ -35,6 +35,7 @@ import statistics
 import time
 import zlib
 from dataclasses import dataclass, field
+from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -45,6 +46,15 @@ from hivemind_tpu.resilience import CHAOS
 from hivemind_tpu.sim.clock import VirtualClockEventLoop, install_virtual_time, uninstall_virtual_time
 from hivemind_tpu.sim.network import LinkMatrix, LinkProfile, Partition, SimNetwork
 from hivemind_tpu.sim.peer import SimPeer
+from hivemind_tpu.telemetry.blackbox import BlackBox
+from hivemind_tpu.telemetry.ledger import RoundLedger
+from hivemind_tpu.telemetry.registry import MetricsRegistry
+from hivemind_tpu.telemetry.tracing import (
+    add_span_listener,
+    remove_span_listener,
+    seed_trace_ids,
+    trace,
+)
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.timed_storage import get_dht_time
 
@@ -80,6 +90,10 @@ def run_scenario(name: str, seed: int = 0, **params) -> ScenarioResult:
     install_virtual_time(loop)
     rng_state = random.getstate()
     random.seed(zlib.crc32(f"{name}|{seed}".encode()))
+    # trace/span ids are OS-seeded by default (forked peers must diverge);
+    # inside a scenario they come from the scenario seed so per-peer black-box
+    # spools are bit-identical across same-seed runs (ISSUE 17)
+    seed_trace_ids(zlib.crc32(f"{name}|{seed}|trace".encode()))
     if CHAOS.enabled:
         CHAOS.reseed(seed)  # replaying the same seed must replay the same faults
     wall_started = time.perf_counter()
@@ -91,6 +105,7 @@ def run_scenario(name: str, seed: int = 0, **params) -> ScenarioResult:
     finally:
         uninstall_virtual_time()
         random.setstate(rng_state)  # the process's global stream is not ours to keep
+        seed_trace_ids(None)  # back to OS entropy: live peers must diverge again
         with contextlib.suppress(Exception):
             _drain_loop(loop)
         asyncio.set_event_loop(None)
@@ -194,6 +209,7 @@ async def _scenario_dht_churn(
     matchmaking_peers: int = 0,
     matchmaking_rounds: int = 2,
     min_matchmaking_time: float = 4.0,
+    blackbox_root: Optional[str] = None,
 ) -> dict:
     network = SimNetwork(LinkMatrix(seed=seed), seed=seed)
     rng = random.Random(zlib.crc32(f"{seed}|churn".encode()))
@@ -265,7 +281,11 @@ async def _scenario_dht_churn(
                 "sim_soak", target_group_size=4, min_matchmaking_time=min_matchmaking_time
             )
         matchmaking_summary = await _run_matchmaking_rounds(
-            network, cohort, rounds=matchmaking_rounds, window=min_matchmaking_time * 6
+            network,
+            cohort,
+            rounds=matchmaking_rounds,
+            window=min_matchmaking_time * 6,
+            blackbox_root=blackbox_root,
         )
 
     # --- probes: seeded sample of keys, each read from a seeded live reader
@@ -410,12 +430,17 @@ async def _match_loop(
     deadline: Optional[float] = None,
     min_lead: float = 0.0,
     poll: float = 0.25,
+    simulate_allreduce: bool = False,
 ) -> None:
     """One peer's matchmaking driver, shared by every scenario: staggered start,
     repeated ``look_for_group`` bounded by ``rounds`` attempts and/or a
     virtual-time ``deadline`` (stop when less than ``min_lead`` remains; with a
     deadline a timed-out attempt ends the loop), appending deterministic
-    ``(rel_time, sorted_member_names)`` records."""
+    ``(rel_time, sorted_member_names)`` records. Each attempt is traced as an
+    ``averaging.matchmaking`` span (the round ledger's wait-time signal), and
+    with ``simulate_allreduce`` a formed group runs one synthesized
+    :meth:`SimPeer.simulate_allreduce_round` so virtual-time ledger records
+    with straggler attribution exist (ISSUE 17)."""
     await asyncio.sleep(_peer_stagger(network.seed, peer.name, spread=2.0))
     attempts = 0
     while rounds is None or attempts < rounds:
@@ -428,17 +453,26 @@ async def _match_loop(
                 return
             timeout = remaining if window is None else min(window, remaining)
         attempts += 1
-        try:
-            group = await asyncio.wait_for(peer.look_for_group(), timeout=timeout)
-        except asyncio.TimeoutError:
-            if deadline is not None:
-                return
-            group = None
-        except Exception:
-            group = None
+        timed_out = False
+        with trace("averaging.matchmaking", peer=peer.name) as mm_span:
+            try:
+                group = await asyncio.wait_for(peer.look_for_group(), timeout=timeout)
+            except asyncio.TimeoutError:
+                group, timed_out = None, True
+            except Exception:
+                group = None
+            if mm_span is not None:
+                mm_span.set(
+                    "outcome",
+                    "timeout" if timed_out else ("matched" if group is not None else "failed"),
+                )
+        if timed_out and deadline is not None:
+            return
         if group is not None:
             members = tuple(sorted(name_of.get(pid, str(pid)) for pid in group.peer_ids))
             records.append((round(network.rel_time(), 3), members))
+            if simulate_allreduce:
+                await peer.simulate_allreduce_round(group)
         await asyncio.sleep(poll)
 
 
@@ -453,18 +487,62 @@ def _dedupe_groups(records: List[Tuple[float, Tuple[str, ...]]]) -> Dict[Tuple[s
 
 
 async def _run_matchmaking_rounds(
-    network: SimNetwork, cohort: Sequence[SimPeer], *, rounds: int, window: float
+    network: SimNetwork,
+    cohort: Sequence[SimPeer],
+    *,
+    rounds: int,
+    window: float,
+    simulate_allreduce: bool = True,
+    blackbox_root: Optional[str] = None,
 ) -> dict:
     """Every cohort peer repeatedly looks for a group for ``rounds`` attempts
-    (bounded by ``window`` sim-seconds each); returns deterministic group facts."""
+    (bounded by ``window`` sim-seconds each); returns deterministic group facts.
+
+    With ``simulate_allreduce`` (the default) every formed group also runs a
+    synthesized all-reduce round, attributed by a PRIVATE :class:`RoundLedger`
+    on a private empty registry — the process-wide registry's counters are
+    cross-test noise and would poison the deterministic digest. The resulting
+    virtual-time ledger summary (rounds, phase quantiles, straggler scores)
+    rides the returned dict. ``blackbox_root`` additionally arms one
+    :class:`BlackBox` spool per cohort peer under ``<root>/<peer name>``,
+    subscribed to the same private ledger — per-peer spools bit-identical
+    across same-seed runs."""
     name_of = {peer.peer_id: peer.name for peer in cohort}
     records: List[Tuple[float, Tuple[str, ...]]] = []
-    await asyncio.gather(
-        *(_match_loop(network, peer, name_of, records, rounds=rounds, window=window) for peer in cohort)
-    )
+    ledger: Optional[RoundLedger] = None
+    boxes: List[BlackBox] = []
+    if simulate_allreduce:
+        ledger = RoundLedger(registry=MetricsRegistry())
+        add_span_listener(ledger.on_span)
+        if blackbox_root is not None:
+            for peer in cohort:
+                boxes.append(
+                    BlackBox(
+                        Path(blackbox_root) / peer.name,
+                        peer=peer.name,
+                        peer_filter=peer.name,
+                        ledger=ledger,
+                        metrics_interval=None,
+                    )
+                )
+    try:
+        await asyncio.gather(
+            *(
+                _match_loop(
+                    network, peer, name_of, records,
+                    rounds=rounds, window=window, simulate_allreduce=simulate_allreduce,
+                )
+                for peer in cohort
+            )
+        )
+    finally:
+        for box in boxes:
+            box.close()
+        if ledger is not None:
+            remove_span_listener(ledger.on_span)
     groups = _dedupe_groups(records)
     matched = {name for members in groups for name in members}
-    return {
+    summary = {
         "cohort": len(cohort),
         "rounds_per_peer": rounds,
         "groups": sorted([t, list(m)] for m, t in groups.items()),
@@ -472,6 +550,9 @@ async def _run_matchmaking_rounds(
         "peers_matched": len(matched),
         "group_sizes": sorted(len(m) for m in groups),
     }
+    if ledger is not None:
+        summary["ledger"] = ledger.summary()
+    return summary
 
 
 async def _scenario_matchmaking_partition(

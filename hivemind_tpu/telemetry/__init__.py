@@ -21,9 +21,22 @@ distributed tracing with a per-process flight recorder.
   batch-assembly / device-compute / serialize, per-expert quantiles, per-client
   attribution, plus client-side expert scorecards; served at ``GET /serving``.
 
+- :mod:`~hivemind_tpu.telemetry.blackbox` — the black-box flight recorder
+  (ISSUE 17): crash-durable on-disk telemetry spools (segment-rotated msgpack
+  frames) fed from the span/ledger hooks, read back by ``hivemind-blackbox``
+  and ``hivemind-top --from-spool`` for cross-peer post-mortems.
+
 See docs/observability.md for the metric catalog and the span catalog.
 """
 
+from hivemind_tpu.telemetry.blackbox import (
+    BlackBox,
+    SpoolWriter,
+    active_blackbox,
+    arm_blackbox,
+    disarm_blackbox,
+    read_spool,
+)
 from hivemind_tpu.telemetry.exporter import MetricsExporter, render_prometheus
 from hivemind_tpu.telemetry.ledger import LEDGER, RoundLedger
 from hivemind_tpu.telemetry.serving import (
@@ -69,6 +82,12 @@ from hivemind_tpu.telemetry.registry import (
 __all__ = [
     "REGISTRY",
     "RECORDER",
+    "BlackBox",
+    "SpoolWriter",
+    "read_spool",
+    "arm_blackbox",
+    "disarm_blackbox",
+    "active_blackbox",
     "LEDGER",
     "RoundLedger",
     "SERVING_LEDGER",

@@ -33,12 +33,11 @@ from __future__ import annotations
 
 import math
 import threading
-import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from hivemind_tpu.telemetry.registry import REGISTRY, MetricsRegistry
-from hivemind_tpu.telemetry.tracing import _WALL_ANCHOR, Span, add_span_listener
+from hivemind_tpu.telemetry.tracing import Span, add_span_listener, wall_anchor, wall_time
 from hivemind_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -117,6 +116,29 @@ class RoundLedger:
         # (and this singleton) in tests and soaks, and peer A's transition must
         # not consume peer B's rounds
         self._epoch_window: Dict[str, Dict[str, Any]] = {}
+        # record listeners (the black-box spool subscribes): called with
+        # ("round"|"epoch", copied record) OUTSIDE the lock — a listener doing
+        # file I/O must not serialize the span hot path. A round retro-updated
+        # by a late exchange is re-emitted; spool readers keep the last copy
+        # per (peer, round).
+        self._record_listeners: List = []
+
+    def add_record_listener(self, listener) -> None:
+        if listener not in self._record_listeners:
+            self._record_listeners.append(listener)
+
+    def remove_record_listener(self, listener) -> None:
+        try:
+            self._record_listeners.remove(listener)
+        except ValueError:
+            pass
+
+    def _notify_record(self, kind: str, record: Dict[str, Any]) -> None:
+        for listener in self._record_listeners:
+            try:
+                listener(kind, record)
+            except Exception as e:  # pragma: no cover - listeners must stay harmless
+                logger.debug(f"ledger record listener failed: {e!r}")
 
     # ------------------------------------------------------------------ feeding
 
@@ -136,11 +158,15 @@ class RoundLedger:
                     # the negotiated wire tier of this link (ISSUE 11) — rides
                     # the record so demotions are visible per round
                     info["codec"] = str(attrs["codec"])
+                updated: Optional[Dict[str, Any]] = None
                 with self._lock:
                     if parent in self._closed_rounds:
                         self._attach_late_exchange(parent, info)
+                        updated = self._copy_record(self._closed_rounds[parent])
                     else:
                         self._pending_exchanges.setdefault(parent, []).append(info)
+                if updated is not None:
+                    self._notify_record("round", updated)
         elif name == "allreduce.local_reduce":
             if span.parent_id:
                 with self._lock:
@@ -176,7 +202,7 @@ class RoundLedger:
             self._round_index += 1
             record: Dict[str, Any] = {
                 "round": self._round_index,
-                "time": round(span.start + span.duration + _WALL_ANCHOR, 3),
+                "time": round(span.start + span.duration + wall_anchor(), 3),
                 "peer": str(attrs.get("peer", "?")),
                 "group_size": attrs.get("group_size"),
                 "rank": attrs.get("rank"),
@@ -244,6 +270,9 @@ class RoundLedger:
                 for key in list(self._pending_exchanges)[: -_MAX_PENDING_ROUNDS // 2]:
                     self._pending_exchanges.pop(key, None)
                     self._pending_local.pop(key, None)
+            published = self._copy_record(record) if self._record_listeners else None
+        if published is not None:
+            self._notify_record("round", published)
 
     def _score(self, remote: str) -> Dict[str, float]:
         return self._straggler.setdefault(
@@ -310,7 +339,7 @@ class RoundLedger:
         with self._lock:
             self._codec_events.append(
                 {
-                    "time": round(time.time(), 3),
+                    "time": round(wall_time(), 3),
                     "peer": str(peer),
                     "action": str(action),
                     "tier": tier,
@@ -344,7 +373,7 @@ class RoundLedger:
             entry: Dict[str, Any] = {
                 "epoch": int(epoch),
                 "peer": str(peer),
-                "time": round(time.time(), 3),
+                "time": round(wall_time(), 3),
                 "rounds": window["rounds"],
                 "round_s": round(window["round_s"], 6),
             }
@@ -356,7 +385,8 @@ class RoundLedger:
                 entry["straggler"] = window["straggler"]
             entry.update(extra)
             self._epochs.append(entry)
-            return dict(entry)
+        self._notify_record("epoch", dict(entry))
+        return dict(entry)
 
     # ------------------------------------------------------------------ reading
 
@@ -392,7 +422,11 @@ class RoundLedger:
         with self._lock:
             items = sorted(
                 ((peer, dict(score)) for peer, score in self._straggler.items()),
-                key=lambda kv: (-kv[1]["rounds_slowest"], -kv[1]["excess_s"]),
+                # peer name breaks ties: without it, tied peers rank by dict
+                # insertion order — i.e. span completion order — and a limited
+                # listing's MEMBERSHIP would vary run to run (the sim hashes
+                # these summaries into its determinism digest)
+                key=lambda kv: (-kv[1]["rounds_slowest"], -kv[1]["excess_s"], kv[0]),
             )
         return dict(items[:limit] if limit else items)
 

@@ -38,13 +38,12 @@ from __future__ import annotations
 
 import sys
 import threading
-import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from hivemind_tpu.telemetry.ledger import _percentile
 from hivemind_tpu.telemetry.registry import REGISTRY, MetricsRegistry
-from hivemind_tpu.telemetry.tracing import Span, add_span_listener, current_span
+from hivemind_tpu.telemetry.tracing import Span, add_span_listener, current_span, wall_time
 from hivemind_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -184,6 +183,20 @@ class ServingLedger:
         self._clients: Dict[str, Dict[str, float]] = {}
         self._request_index = 0
         self._totals = {"requests": 0, "errors": 0, "sheds": 0}
+        # record listeners (the black-box spool subscribes): called with
+        # ("serving", copied record) OUTSIDE the lock — file I/O must not
+        # serialize the serving hot path
+        self._record_listeners: List = []
+
+    def add_record_listener(self, listener) -> None:
+        if listener not in self._record_listeners:
+            self._record_listeners.append(listener)
+
+    def remove_record_listener(self, listener) -> None:
+        try:
+            self._record_listeners.remove(listener)
+        except ValueError:
+            pass
 
     # ------------------------------------------------------------------ feeding
 
@@ -217,7 +230,7 @@ class ServingLedger:
         with self._lock:
             self._request_index += 1
             record["request"] = self._request_index
-            record["time"] = round(time.time(), 3)
+            record["time"] = round(wall_time(), 3)
             self._records.append(record)
             self._totals["requests"] += 1
             stats = self._expert_stats(record["expert"])
@@ -243,6 +256,13 @@ class ServingLedger:
                 self._slowest.append(dict(record))
                 self._slowest.sort(key=lambda r: -r["total_s"])
                 del self._slowest[self._slowest_capacity:]
+            published = dict(record) if self._record_listeners else None
+        if published is not None:
+            for listener in self._record_listeners:
+                try:
+                    listener("serving", published)
+                except Exception as e:  # pragma: no cover - listeners stay harmless
+                    logger.debug(f"serving record listener failed: {e!r}")
 
     def _expert_stats(self, uid: str) -> _ExpertStats:
         stats = self._experts.get(uid)

@@ -50,10 +50,96 @@ logger = get_logger(__name__)
 
 _CTX_STRUCT = struct.Struct(">QQ")  # (trace_id, span_id) — the wire context
 
-# wall-clock anchor: spans are timed with perf_counter (monotonic, immune to
-# NTP steps); export adds this offset so timelines from different peers align
-# on the wall clock as well as their clocks themselves agree
-_WALL_ANCHOR = time.time() - time.perf_counter()
+# ------------------------------------------------------------- telemetry clock
+#
+# Spans are timed with :func:`telemetry_time` — ``time.perf_counter`` by
+# default (monotonic, immune to NTP steps). The simulator swaps it for the
+# virtual loop clock via :func:`set_telemetry_time_source` (mirroring
+# ``set_dht_time_source``: a module-global function pointer, NOT a
+# monkeypatch, because callers across the tree bind these functions at
+# import). Export adds the wall anchor from :func:`wall_anchor` so timelines
+# from different peers align on the wall clock.
+#
+# The anchor used to be computed ONCE at import (ISSUE 17 satellite): over a
+# long run perf_counter and the wall clock drift apart (and an NTP step moves
+# the wall clock outright), so an import-time anchor skews cross-peer merges
+# by however much the clocks diverged since startup. It is now re-computed
+# when older than _ANCHOR_MAX_AGE_S, and the spool segment headers record the
+# anchor plus the drift observed at the last re-anchor (wall_anchor_info) so
+# post-mortem merges can bound the residual skew.
+
+_ANCHOR_MAX_AGE_S = 60.0
+# {"anchor": wall - perf at last re-anchor, "at": monotonic re-anchor time,
+#  "drift_s": anchor movement observed at the last re-anchor} — dict ops are
+# GIL-atomic; a racing re-anchor just recomputes the same values.
+_anchor_state: Dict[str, float] = {
+    "anchor": time.time() - time.perf_counter(), "at": time.monotonic(), "drift_s": 0.0
+}
+
+_time_source = None  # swapped by the sim; None = time.perf_counter
+_wall_source = None  # paired wall clock; None = time.time
+
+
+def set_telemetry_time_source(source=None, wall_source=None) -> None:
+    """Swap the clock spans/ledgers/watchdogs are timed with (None restores
+    the defaults). ``source`` replaces ``perf_counter`` for span timing;
+    ``wall_source`` replaces ``time.time`` for record timestamps and defaults
+    to ``source`` — the virtual loop clock starts at an epoch-magnitude value,
+    so it serves as both, and the wall anchor is then exactly 0.0 (per-peer
+    spools from one sim merge without skew correction)."""
+    global _time_source, _wall_source
+    _time_source = source
+    _wall_source = wall_source if wall_source is not None else source
+
+
+def telemetry_time() -> float:
+    """The span clock: ``perf_counter`` unless the sim swapped it."""
+    if _time_source is not None:
+        return _time_source()
+    return time.perf_counter()
+
+
+def wall_time() -> float:
+    """Wall-clock timestamps for ledger/watchdog records: ``time.time``
+    unless the sim swapped the clock (virtual time is epoch-magnitude)."""
+    if _wall_source is not None:
+        return _wall_source()
+    return time.time()
+
+
+def _reanchor() -> None:
+    state = _anchor_state
+    new_anchor = time.time() - time.perf_counter()
+    state["drift_s"] = round(new_anchor - state["anchor"], 6)
+    state["anchor"] = new_anchor
+    state["at"] = time.monotonic()
+
+
+def wall_anchor() -> float:
+    """Offset such that ``telemetry_time() + wall_anchor() ≈ wall_time()``.
+    Re-anchored when stale; exactly 0.0 under a virtual clock."""
+    if _time_source is not None:
+        return 0.0
+    state = _anchor_state
+    if time.monotonic() - state["at"] > _ANCHOR_MAX_AGE_S:
+        _reanchor()
+    return state["anchor"]
+
+
+def wall_anchor_info() -> Dict[str, Any]:
+    """Anchor + drift estimate for spool segment headers: ``{"anchor",
+    "drift_s", "age_s", "clock"}`` where drift_s is how far the anchor moved
+    at the last re-anchor (≈ clock divergence per _ANCHOR_MAX_AGE_S window)."""
+    if _time_source is not None:
+        return {"anchor": 0.0, "drift_s": 0.0, "age_s": 0.0, "clock": "virtual"}
+    anchor = wall_anchor()
+    state = _anchor_state
+    return {
+        "anchor": round(anchor, 6),
+        "drift_s": state["drift_s"],
+        "age_s": round(time.monotonic() - state["at"], 3),
+        "clock": "wall",
+    }
 
 _current_span: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
     "hivemind_current_span", default=None
@@ -77,6 +163,14 @@ def thread_current_span(thread_id: int) -> Optional["Span"]:
 # one rng for id generation; seeded from the OS so forked peers diverge.
 # random.Random methods are atomic under the GIL — no lock needed.
 _ids = random.Random(int.from_bytes(os.urandom(8), "big") ^ os.getpid())
+
+
+def seed_trace_ids(seed: int) -> None:
+    """Reseed the trace/span id rng. The rng is OS-seeded so forked peers
+    diverge — which also means two same-seed sim runs produce different ids;
+    sim scenarios call this so spool contents are bit-identical per seed."""
+    global _ids
+    _ids = random.Random(seed)
 
 enabled = os.environ.get("HIVEMIND_TRACE", "1") != "0"
 
@@ -105,7 +199,7 @@ class Span:
         self.trace_id = trace_id if trace_id else _new_id()
         self.span_id = _new_id()
         self.parent_id = parent_id
-        self.start = time.perf_counter()
+        self.start = telemetry_time()
         self.end: Optional[float] = None
         self.attributes = attributes
         self.events: Optional[List[Tuple[float, str, Optional[Dict[str, Any]]]]] = None
@@ -123,11 +217,11 @@ class Span:
         trip, retry attempt, ...). Cheap: one tuple append."""
         if self.events is None:
             self.events = []
-        self.events.append((time.perf_counter(), name, attributes or None))
+        self.events.append((telemetry_time(), name, attributes or None))
 
     @property
     def duration(self) -> float:
-        return (self.end if self.end is not None else time.perf_counter()) - self.start
+        return (self.end if self.end is not None else telemetry_time()) - self.start
 
     def context_bytes(self) -> bytes:
         """The 16-byte wire context piggybacked on RPC envelopes."""
@@ -141,7 +235,7 @@ class Span:
             "name": self.name,
             "trace": f"{self.trace_id:016x}",
             "span": f"{self.span_id:016x}",
-            "start": round(self.start + _WALL_ANCHOR, 6),
+            "start": round(self.start + wall_anchor(), 6),
             "dur_ms": round(self.duration * 1e3, 3),
         }
         if self.parent_id:
@@ -275,7 +369,13 @@ def start_span(
     else:
         trace_id = parent.trace_id if parent is not None else None
         parent_id = parent.span_id if parent is not None else None
-    return Span(name, trace_id=trace_id, parent_id=parent_id, attributes=attributes or None)
+    span = Span(name, trace_id=trace_id, parent_id=parent_id, attributes=attributes or None)
+    for listener in _SPAN_START_LISTENERS:
+        try:
+            listener(span)
+        except Exception as e:  # pragma: no cover - listeners must stay harmless
+            logger.debug(f"span start listener failed on {span.name!r}: {e!r}")
+    return span
 
 
 # finished-span listeners (the round ledger subscribes here): called after the
@@ -283,6 +383,12 @@ def start_span(
 # operation it observes. Kept as a plain list read without a lock (GIL-atomic);
 # registration happens at import/startup time.
 _SPAN_LISTENERS: List = []
+
+# span-START listeners (the black-box spool subscribes here): a crash-killed
+# peer's last operation never reaches finish_span, so post-mortem needs the
+# open span on disk BEFORE the work runs. Every code path creating a span goes
+# through start_span (trace.__enter__ included), so this is the one hook.
+_SPAN_START_LISTENERS: List = []
 
 
 def add_span_listener(listener) -> None:
@@ -298,12 +404,26 @@ def remove_span_listener(listener) -> None:
         pass
 
 
+def add_span_start_listener(listener) -> None:
+    """Register ``listener(span)`` to run on every span CREATION (the span is
+    still open — its ``end`` is None and attributes may still grow)."""
+    if listener not in _SPAN_START_LISTENERS:
+        _SPAN_START_LISTENERS.append(listener)
+
+
+def remove_span_start_listener(listener) -> None:
+    try:
+        _SPAN_START_LISTENERS.remove(listener)
+    except ValueError:
+        pass
+
+
 def finish_span(span: Optional[Span], recorder: Optional[SpanRecorder] = None) -> None:
     """Stamp the end time and append to the flight recorder. None-safe so call
     sites need no enabled-check of their own."""
     if span is None:
         return
-    span.end = time.perf_counter()
+    span.end = telemetry_time()
     (recorder if recorder is not None else RECORDER).record(span)
     for listener in _SPAN_LISTENERS:
         try:
@@ -383,6 +503,7 @@ def render_chrome_trace(
     events render as instant events on the same row, and every event carries
     its trace/span/parent ids in ``args`` so traces remain greppable."""
     spans = RECORDER.snapshot() if spans is None else list(spans)
+    anchor = wall_anchor()
     peers: Dict[str, int] = {}
     events: List[Dict[str, Any]] = []
     for span in spans:
@@ -392,7 +513,7 @@ def render_chrome_trace(
         pid = peers.get(peer)
         if pid is None:
             pid = peers[peer] = len(peers) + 1
-        ts_us = (span.start + _WALL_ANCHOR) * 1e6
+        ts_us = (span.start + anchor) * 1e6
         dur_us = max(span.duration * 1e6, 0.001)
         args: Dict[str, Any] = {
             "trace_id": f"{span.trace_id:016x}",
@@ -418,7 +539,7 @@ def render_chrome_trace(
             events.append(
                 {
                     "name": event_name, "cat": "event", "ph": "i", "s": "t",
-                    "ts": round((when + _WALL_ANCHOR) * 1e6, 3),
+                    "ts": round((when + anchor) * 1e6, 3),
                     "pid": pid, "tid": span.thread_id % 2**31, "args": instant_args,
                 }
             )

@@ -41,7 +41,7 @@ import traceback
 from typing import Any, Dict, List, Optional
 
 from hivemind_tpu.telemetry.registry import REGISTRY, MetricsRegistry
-from hivemind_tpu.telemetry.tracing import thread_current_span
+from hivemind_tpu.telemetry.tracing import thread_current_span, wall_time
 from hivemind_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -202,7 +202,7 @@ class EventLoopWatchdog:
         # travels in snapshots/events; the full stack stays local (log + here)
         frame_tail = stack.strip().splitlines()[-1].strip() if stack else ""
         self.last_stall = {
-            "time": round(time.time(), 3),
+            "time": round(wall_time(), 3),
             "loop": self.name,
             "blocked_s_at_capture": round(blocked_for, 3),
             "threshold_s": self.stall_threshold,

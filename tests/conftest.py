@@ -109,11 +109,13 @@ def cleanup_children(request):
 
     from hivemind_tpu.resilience import CHAOS, reset_all_boards
     from hivemind_tpu.telemetry import watchdog as telemetry_watchdog
+    from hivemind_tpu.telemetry.blackbox import disarm_blackbox
     from hivemind_tpu.telemetry.ledger import LEDGER
     from hivemind_tpu.telemetry.serving import SCORECARDS, SERVING_LEDGER
     from hivemind_tpu.telemetry.tracing import RECORDER
     from hivemind_tpu.utils.crypto import Ed25519PrivateKey
 
+    disarm_blackbox()  # a test's armed spool must never capture the next test's spans
     CHAOS.clear()  # a test's armed fault rules must never leak into the next test
     reset_all_boards()  # module-level breaker boards (e.g. moe EXPERT_BREAKERS) too
     RECORDER.clear()  # one test's spans must not satisfy another's assertions

@@ -106,6 +106,57 @@ def test_scoping_fixture_outside_rule_scope_is_ignored(tmp_path):
     assert get_rule("hotpath-copies")().run(ctx) == []
 
 
+# --------------------------------------------------- project-rule fixture trees
+
+# The cross-file rules (metric-docs, chaos-coverage, wire-drift) check-in whole
+# mini-repo TREES under fixtures/<rule>/{flag,ok}/ — the flag tree MUST produce
+# exactly these kinds, the ok tree MUST stay silent (ISSUE 17 satellite).
+_TREE_CASES = [
+    ("metric-docs", {"undocumented-metric", "dynamic-metric-name"}),
+    ("chaos-coverage", {
+        "undocumented:net.ghost",  # declared, not in the doc
+        "unexercised:net.ghost",  # declared, not in DEFAULT_SCHEDULE
+        "phantom:net.typo",  # soaked, not declared
+        "stale-doc:net.removed",  # catalog row for a deleted point
+        "unknown:net.bogus",  # inject() literal for an undeclared point
+    }),
+    ("wire-drift", {"tag-drift", "tag-unverifiable"}),
+]
+
+
+def _tree_ctx(tmp_path: Path, rule_name: str, variant: str) -> LintContext:
+    root = tmp_path / variant
+    shutil.copytree(FIXTURES / rule_name / variant, root)
+    return LintContext(repo_root=root, package_root=root / "hivemind_tpu")
+
+
+@pytest.mark.parametrize("rule_name,expected", _TREE_CASES, ids=[c[0] for c in _TREE_CASES])
+def test_project_rule_flags_its_fixture_tree(tmp_path, rule_name, expected):
+    findings, _warnings = get_rule(rule_name)().run(_tree_ctx(tmp_path, rule_name, "flag"))
+    assert {f.kind for f in findings} == expected, [f.render() for f in findings]
+
+
+@pytest.mark.parametrize("rule_name,expected", _TREE_CASES, ids=[c[0] for c in _TREE_CASES])
+def test_project_rule_passes_its_synced_tree(tmp_path, rule_name, expected):
+    findings, _warnings = get_rule(rule_name)().run(_tree_ctx(tmp_path, rule_name, "ok"))
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_every_rule_ships_must_flag_and_must_pass_fixtures():
+    """All nine rules carry checked-in fixtures: file pairs for the AST rules,
+    mini-repo trees for the cross-file project rules."""
+    covered = {case[0] for case in _AST_CASES} | {case[0] for case in _TREE_CASES}
+    assert covered == {rule_cls.name for rule_cls in ALL_RULES}
+    for rule_cls in ALL_RULES:
+        fixture_dir = FIXTURES / rule_cls.name
+        assert (fixture_dir / "flag.py").is_file() or (fixture_dir / "flag").is_dir(), (
+            f"{rule_cls.name}: no MUST-flag fixture"
+        )
+        assert (fixture_dir / "ok.py").is_file() or (fixture_dir / "ok").is_dir(), (
+            f"{rule_cls.name}: no MUST-pass fixture"
+        )
+
+
 # ----------------------------------------------------------- project rules
 
 

@@ -1,0 +1,2 @@
+# An inject() literal naming a point the engine never declared (unknown).
+CHAOS.inject("net.bogus")

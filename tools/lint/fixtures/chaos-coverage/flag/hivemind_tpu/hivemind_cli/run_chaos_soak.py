@@ -1,0 +1,6 @@
+# net.typo is soaked but the engine declares no such point (phantom).
+DEFAULT_SCHEDULE = (
+    ("dht.rpc_drop", 0.1),
+    ("net.stall", 0.1),
+    ("net.typo", 0.1),
+)

@@ -1,0 +1,6 @@
+# MUST-flag fixture: net.ghost is declared but neither documented nor soaked.
+INJECTION_POINTS = (
+    "dht.rpc_drop",
+    "net.stall",
+    "net.ghost",
+)

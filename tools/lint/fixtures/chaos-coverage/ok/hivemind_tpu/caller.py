@@ -1,0 +1,1 @@
+CHAOS.inject("net.stall")

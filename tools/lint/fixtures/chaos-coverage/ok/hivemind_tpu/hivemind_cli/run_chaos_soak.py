@@ -1,0 +1,4 @@
+DEFAULT_SCHEDULE = (
+    ("dht.rpc_drop", 0.1),
+    ("net.stall", 0.1),
+)

@@ -1,0 +1,5 @@
+# MUST-pass fixture: every declared point is documented AND soaked.
+INJECTION_POINTS = (
+    "dht.rpc_drop",
+    "net.stall",
+)

@@ -1,0 +1,7 @@
+# MUST-flag fixture for metric-docs: one metric the catalog never mentions
+# (undocumented-metric) and one registered under a computed name the lint
+# cannot tie to a catalog row (dynamic-metric-name).
+DOCUMENTED = REGISTRY.counter("hivemind_fixture_documented_total", "in the catalog", ())
+PHANTOM = REGISTRY.counter("hivemind_fixture_phantom_total", "absent from the catalog", ())
+name = "hivemind_" + "computed"
+DYNAMIC = REGISTRY.gauge(name, "uncatalogable")

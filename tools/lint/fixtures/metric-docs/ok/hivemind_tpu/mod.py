@@ -1,0 +1,4 @@
+# MUST-pass fixture for metric-docs: every registration is a string literal
+# and every name has a catalog row.
+DOCUMENTED = REGISTRY.counter("hivemind_fixture_documented_total", "in the catalog", ())
+ALSO = REGISTRY.gauge("hivemind_fixture_depth", "also in the catalog")

@@ -1,0 +1,13 @@
+# MUST-flag fixture for wire-drift's hand-rolled-tag checks.
+
+# tag-drift: ExpertRequest.uid is field 1 wire type 2 -> the tag byte must be
+# b"\x0a"; b"\x12" is field 2's tag, the exact renumbering bug the rule exists
+# to catch (frames the canonical parser rejects)
+_REQUEST_UID_TAG = b"\x12"  # ExpertRequest.uid = 1
+
+# tag-unverifiable: no `# Message.field = N` comment ties this constant to a
+# proto field, so the lint cannot prove it right or wrong
+_REQUEST_METADATA_TAG = b"\x1a"
+
+# tag-drift: claims a field the checked-in descriptors never declare
+_REQUEST_GHOST_TAG = b"\x22"  # ExpertRequest.ghost = 4

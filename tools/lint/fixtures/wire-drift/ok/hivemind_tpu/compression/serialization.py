@@ -1,0 +1,4 @@
+# MUST-pass fixture for wire-drift: every hand-rolled tag carries its
+# `# Message.field = N` annotation and the bytes match varint((N << 3) | wt).
+_REQUEST_UID_TAG = b"\x0a"  # ExpertRequest.uid = 1
+_REQUEST_METADATA_TAG = b"\x1a"  # ExpertRequest.metadata = 3

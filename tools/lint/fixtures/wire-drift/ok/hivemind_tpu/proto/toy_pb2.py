@@ -1,0 +1,6 @@
+# Synthetic descriptor for the wire-drift fixture (toy.proto: ExpertRequest
+# with uid = 1 string, metadata = 3 bytes). Never imported — the rule reads
+# the AddSerializedFile blob straight off the AST.
+DESCRIPTOR = _descriptor_pool.Default().AddSerializedFile(
+    b'\n\ttoy.proto\x12\x03toy".\n\rExpertRequest\x12\x0b\n\x03uid\x18\x01 \x01(\t\x12\x10\n\x08metadata\x18\x03 \x01(\x0cb\x06proto3'
+)
