@@ -496,6 +496,24 @@ def telemetry_section(averaging=None, serving=None) -> dict:
     serving_extra = (serving or {}).get("extra") or {}
     if serving_extra.get("serving"):
         section["serving"] = serving_extra["serving"]
+    # ISSUE 19: the device-side story — this process's compile/memory/transfer
+    # snapshot, plus the serving subprocess's steady-state compile guard (a
+    # recompile storm in the decode loop is a silent tok/s regression)
+    device: dict = {}
+    try:
+        from hivemind_tpu.telemetry.device import device_snapshot
+
+        local = device_snapshot()
+        if local:
+            device["bench_process"] = local
+    except Exception as e:
+        device["error"] = repr(e)[:200]
+    if serving_extra.get("device") is not None:
+        device["serving"] = serving_extra["device"]
+    if serving_extra.get("steady_state_compiles") is not None:
+        device["serving_steady_state_compiles"] = serving_extra["steady_state_compiles"]
+    if device:
+        section["device"] = device
     return section
 
 

@@ -315,6 +315,16 @@ def main():
     from hivemind_tpu.moe import RemoteSequential
     from hivemind_tpu.moe.server.llama_loader import load_llama_blocks
     from hivemind_tpu.moe.server.server import Server
+    from hivemind_tpu.telemetry.device import (
+        COMPILE_TRACKER,
+        arm_device_telemetry,
+        device_snapshot,
+    )
+
+    # device telemetry rides every serving benchmark (ISSUE 19): steady-state
+    # decode must never recompile, and the extras carry the compile/transfer
+    # summary so bench.py lands it under telemetry.device
+    arm_device_telemetry()
 
     with tempfile.TemporaryDirectory() as tmp:
         if args.checkpoint:
@@ -381,6 +391,7 @@ def main():
                 return out
 
             wire_before = client_wire_bytes()
+            compiles_before = COMPILE_TRACKER.total()
             start = time.perf_counter()
             pipe.decode_step(hidden[:, : args.prompt], "bench", reset=True)
             for t in range(args.generate):
@@ -404,6 +415,16 @@ def main():
             }
             if args.smoke and not all(wire_delta.get(k, 0) > 0 for k in ("sent", "received")):
                 raise SystemExit(f"smoke mode: serving wire-bytes counters did not move: {wire_delta}")
+            # recompile-storm guard (ISSUE 19): the warm session compiled both
+            # the prefill and single-token shapes, so the timed window must be
+            # compile-free — a nonzero delta is a silent tok/s regression
+            steady_state_compiles = COMPILE_TRACKER.total() - compiles_before
+            if args.smoke and steady_state_compiles:
+                raise SystemExit(
+                    f"smoke mode: {steady_state_compiles} recompile(s) in the "
+                    f"steady-state decode window (sites: {COMPILE_TRACKER.counts()})"
+                )
+            device = device_snapshot()
             # serving attribution rides the artifact (ISSUE 9): the server ran
             # in-process, so the global ledger holds every request's phase
             # decomposition — bench.py lands this under telemetry.serving
@@ -431,6 +452,13 @@ def main():
                     # per generated token (the fp16-vs-fp32 wire A/B headline)
                     "wire_bytes_per_token": wire_per_token,
                     "serving": SERVING_LEDGER.summary(),
+                    "steady_state_compiles": steady_state_compiles,
+                    "device": {
+                        "compiles": (device.get("compiles") or {}).get("total", 0),
+                        "compile_seconds": (device.get("compiles") or {}).get("seconds", 0.0),
+                        "storms": (device.get("compiles") or {}).get("storms", 0),
+                        "transfer_bytes": device.get("transfer_bytes"),
+                    },
                 },
             }))
         finally:

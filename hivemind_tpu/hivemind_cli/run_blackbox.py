@@ -143,6 +143,7 @@ def reconstruct_final_round(
     finished: Dict[str, Dict[str, Any]] = {}
     starts: List[Tuple[float, Dict[str, Any]]] = []
     last_finished: Optional[Dict[str, Any]] = None
+    device = _aggregate_device_frames(frames)
     for frame in frames:
         kind, data = frame["k"], frame["d"]
         if kind == "ledger_round":
@@ -165,8 +166,62 @@ def reconstruct_final_round(
     }
     if last_epoch is not None:
         out["last_epoch"] = last_epoch
+    if device:
+        # ISSUE 19: the victim's last device-side state — its final compile
+        # and HBM sample are part of "what was it doing when it died"
+        out["device"] = device
     if stats is not None:
         out["reader_stats"] = dict(stats)
+    return out
+
+
+def _aggregate_device_frames(frames: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """``device`` frames rolled into one snapshot-shaped section: per-site
+    compile counts (recomputed by replay), the newest memory sample, storm /
+    leak counts, and the last overlap record. Empty dict when the spool holds
+    no device telemetry (pre-ISSUE-19 spools stay readable)."""
+    # sites carry {"count": ...} dicts — the SAME shape as the live
+    # device_snapshot(), so hivemind-top's device board renders either
+    sites: Dict[str, Dict[str, int]] = {}
+    out: Dict[str, Any] = {}
+    storms = leaks = 0
+    last_compile = last_memory = None
+    ratios: List[float] = []
+    for frame in frames:
+        if frame["k"] != "device" or not isinstance(frame["d"], dict):
+            continue
+        data = frame["d"]
+        kind = data.get("kind")
+        if kind == "compile":
+            # each frame carries the site's running count: the last one wins
+            sites[str(data.get("site"))] = {"count": int(data.get("count", 0))}
+            last_compile = data
+        elif kind == "storm":
+            storms += 1
+        elif kind == "memory":
+            last_memory = data
+        elif kind == "leak":
+            leaks += 1
+        elif kind == "overlap":
+            ratios.append(float(data.get("overlap_ratio", 0.0)))
+    if sites:
+        out["compiles"] = {
+            "total": sum(site["count"] for site in sites.values()),
+            "sites": sites,
+            "storms": storms,
+            "last": last_compile,
+        }
+        out["last_compile"] = last_compile
+    if last_memory is not None:
+        out["memory"] = {k: v for k, v in last_memory.items() if k != "kind"}
+    if leaks:
+        out["leaks_suspected"] = leaks
+    if ratios:
+        out["overlap"] = {
+            "rounds": len(ratios),
+            "last": ratios[-1],
+            "mean": round(sum(ratios) / len(ratios), 4),
+        }
     return out
 
 
@@ -174,8 +229,14 @@ def render_spool_chrome_trace(merged: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Merged span frames as Chrome trace-event JSON (Perfetto): one pid row
     per peer, finished spans as complete events, still-open spans as instants
     flagged ``in_flight`` — on a dead peer's row, the instant at the end IS
-    the crash site."""
+    the crash site. Comm/compute spans land on fixed named lanes per peer
+    (ISSUE 19, mirroring ``tracing.export_chrome_trace``) so the overlap the
+    StepTimeline scores is visible as two stacked rows."""
+    from hivemind_tpu.telemetry.device import span_lane
+
+    lane_tids = {"compute": 1, "comm": 2}
     peers: Dict[str, int] = {}
+    lanes_used: set = set()
     events: List[Dict[str, Any]] = []
     finished_ids = {
         f["d"].get("span") for f in merged if f["k"] == "span" and isinstance(f["d"], dict)
@@ -192,24 +253,36 @@ def render_spool_chrome_trace(merged: List[Dict[str, Any]]) -> Dict[str, Any]:
         args["span_id"] = data.get("span")
         if data.get("parent"):
             args["parent_id"] = data["parent"]
+        lane = span_lane(str(data.get("name") or ""))
+        if lane is not None:
+            tid = lane_tids[lane]
+            args["lane"] = lane
+            lanes_used.add((pid, lane))
+        else:
+            tid = 3
         if frame["k"] == "span":
             events.append(
                 {"name": data.get("name"), "cat": "span", "ph": "X",
                  "ts": round(float(data.get("start", frame["t"])) * 1e6, 3),
                  "dur": round(max(float(data.get("dur_s", 0.0)) * 1e6, 0.001), 3),
-                 "pid": pid, "tid": 1, "args": args}
+                 "pid": pid, "tid": tid, "args": args}
             )
         elif data.get("span") not in finished_ids:
             args["in_flight"] = True
             events.append(
                 {"name": data.get("name"), "cat": "span", "ph": "i", "s": "p",
                  "ts": round(float(data.get("start", frame["t"])) * 1e6, 3),
-                 "pid": pid, "tid": 1, "args": args}
+                 "pid": pid, "tid": tid, "args": args}
             )
     for peer, pid in peers.items():
         events.append(
             {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
              "args": {"name": f"peer {peer}"}}
+        )
+    for pid, lane in sorted(lanes_used):
+        events.append(
+            {"name": "thread_name", "ph": "M", "pid": pid,
+             "tid": lane_tids[lane], "args": {"name": lane}}
         )
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
@@ -269,6 +342,11 @@ def spool_snapshot(spool: Dict[str, Any]) -> Dict[str, Any]:
              "events": [e[1] for e in d.get("events") or ()]}
             for d in slow[:3]
         ]
+    device = _aggregate_device_frames(frames)
+    if device:
+        # same shape as the live snapshot's device section — hivemind-top's
+        # device board renders a dead peer exactly like a live one
+        snapshot["device"] = device
     return snapshot
 
 
@@ -312,6 +390,23 @@ def _text_report(
             )
         elif post["last_span"] is not None:
             lines.append(f"  last finished span: {post['last_span'].get('name')}")
+        device = post.get("device") or {}
+        compiles = device.get("compiles")
+        if compiles:
+            last_compile = device.get("last_compile") or {}
+            lines.append(
+                f"  device: {compiles.get('total', 0)} compile(s), "
+                f"{compiles.get('storms', 0)} storm(s); last compile at site "
+                f"{last_compile.get('site')!r}"
+            )
+        memory = device.get("memory")
+        if memory:
+            lines.append(
+                f"  device memory at death: {memory.get('total_bytes', 0)} live bytes "
+                f"across {memory.get('buffers', 0)} buffer(s)"
+                + (f", leaks suspected: {device['leaks_suspected']}"
+                   if device.get("leaks_suspected") else "")
+            )
     return "\n".join(lines)
 
 

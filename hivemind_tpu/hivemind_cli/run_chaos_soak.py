@@ -41,6 +41,11 @@ from hivemind_tpu.hivemind_cli.run_blackbox import reconstruct_final_round
 from hivemind_tpu.resilience import CHAOS, INJECTION_POINTS, reset_all_boards
 from hivemind_tpu.telemetry import REGISTRY
 from hivemind_tpu.telemetry.blackbox import BlackBox, read_spool
+from hivemind_tpu.telemetry.device import (
+    arm_device_telemetry,
+    device_snapshot,
+    disarm_device_telemetry,
+)
 from hivemind_tpu.telemetry.ledger import LEDGER
 from hivemind_tpu.telemetry.tracing import RECORDER, thread_current_span
 from hivemind_tpu.telemetry.watchdog import watchdog_summary
@@ -88,7 +93,9 @@ def _toy_problem(seed: int = 0):
     features = rng.randn(256, 8).astype(np.float32)
     targets = features @ true_w
 
-    @jax.jit
+    from hivemind_tpu.utils.profiling import tracked_jit
+
+    @tracked_jit(site="chaos_soak.loss_and_grad")
     def loss_and_grad(params, x, y):
         return jax.value_and_grad(lambda p: jnp.mean((x @ p["w"] - y) ** 2))(params)
 
@@ -148,6 +155,9 @@ def run_soak(
     # same for the round ledger (ISSUE 8): every record + straggler attribution
     # found at verdict time was produced under this soak's rounds
     LEDGER.clear()
+    # device-side observability (ISSUE 19): compile/memory events spool into
+    # every peer's black box, so a victim's corpse carries its last device state
+    arm_device_telemetry()
 
     def _total_watchdog_stalls() -> float:
         metric = REGISTRY.get("hivemind_event_loop_stalls_total")
@@ -571,6 +581,7 @@ def run_soak(
         report["watchdog"] = watchdog_summary()
         report["watchdog_stalls_while_disarmed"] = stalls_while_disarmed
         report["ledger_summary"] = LEDGER.summary()
+        report["device"] = device_snapshot()
 
         # post-mortem (ISSUE 17): every kill -9'd victim left an unpublished
         # ``.open`` spool behind; rebuild its final round from the corpse with
@@ -588,9 +599,11 @@ def run_soak(
                 continue
             final_round = post.get("final_round") or {}
             in_flight = post.get("last_in_flight") or {}
+            device_frames = sum(1 for frame in frames if frame.get("k") == "device")
             postmortems[spool_dir] = {
                 "peer": f"peer{entry['index']}",
                 "frames": spool_stats.get("frames", 0),
+                "device_frames": device_frames,
                 "torn_tail": spool_stats.get("torn_tail", 0),
                 "corrupt": spool_stats.get("corrupt", 0),
                 "final_round": final_round.get("round"),
@@ -649,6 +662,11 @@ def run_soak(
             checks["postmortem_reconstructed"] = bool(postmortems) and any(
                 entry.get("reconstructed") for entry in postmortems.values()
             )
+            # device telemetry is crash-durable too (ISSUE 19): at least one
+            # victim's corpse must carry compile/memory frames
+            checks["device_frames_in_victim_spool"] = bool(postmortems) and any(
+                entry.get("device_frames", 0) > 0 for entry in postmortems.values()
+            )
         report["checks"] = checks
         report["ok"] = all(checks.values())
         return report
@@ -659,6 +677,7 @@ def run_soak(
         CHAOS.clear()
         EXPERT_BREAKERS.reconfigure(recovery_time=original_expert_recovery)
         reset_all_boards()
+        disarm_device_telemetry()
         if checkpoint_dir_ctx is not None:
             checkpoint_dir_ctx.cleanup()
         if blackbox_dir_ctx is not None:

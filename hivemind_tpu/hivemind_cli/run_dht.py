@@ -41,6 +41,11 @@ def main():
                              "snapshots are appended as msgpack frames readable "
                              "post-mortem with hivemind-blackbox (see "
                              "docs/observability.md 'Black-box flight recorder')")
+    parser.add_argument("--no_device_telemetry", action="store_false", dest="device_telemetry",
+                        help="disable device-side observability (jit compile tracking, "
+                             "HBM/leak sampling; docs/observability.md 'Device telemetry'); "
+                             "on by default — a DHT-only peer that never touches jax "
+                             "pays nothing (the sampler is a no-op without a backend)")
     from hivemind_tpu.utils.platform import add_platform_arg, apply_platform
 
     add_platform_arg(parser)
@@ -66,6 +71,11 @@ def main():
 
         blackbox = arm_blackbox(args.blackbox_dir, peer=str(dht.peer_id))
         logger.info(f"black-box recorder armed: spooling to {args.blackbox_dir}")
+
+    if args.device_telemetry:
+        from hivemind_tpu.telemetry.device import arm_device_telemetry
+
+        arm_device_telemetry()
 
     # the DHT armed the event-loop watchdog on its loop; asserting here keeps
     # the CLI loud if the kill switch (HIVEMIND_WATCHDOG=0) disabled it
@@ -109,6 +119,10 @@ def main():
             from hivemind_tpu.telemetry.blackbox import disarm_blackbox
 
             disarm_blackbox()
+        if args.device_telemetry:
+            from hivemind_tpu.telemetry.device import disarm_device_telemetry
+
+            disarm_device_telemetry()
         dht.shutdown()
 
 

@@ -112,6 +112,10 @@ def main():
                              "metric snapshots are appended as msgpack frames "
                              "readable post-mortem with hivemind-blackbox (see "
                              "docs/observability.md 'Black-box flight recorder')")
+    parser.add_argument("--no_device_telemetry", action="store_false", dest="device_telemetry",
+                        help="disable device-side observability (jit compile tracking, "
+                             "HBM/leak sampling on the watchdog tick, transfer counters; "
+                             "docs/observability.md 'Device telemetry'); on by default")
     from hivemind_tpu.utils.platform import add_platform_arg, apply_platform
 
     add_platform_arg(parser)
@@ -277,6 +281,13 @@ def _start_telemetry(args, dht):
     if ensure_watchdog(get_loop_runner().loop) is None:
         logger.warning("event-loop watchdog disabled (HIVEMIND_WATCHDOG=0): stalls will be silent")
     components = []
+    if getattr(args, "device_telemetry", True):
+        import types
+
+        from hivemind_tpu.telemetry.device import arm_device_telemetry, disarm_device_telemetry
+
+        arm_device_telemetry()
+        components.append(types.SimpleNamespace(shutdown=disarm_device_telemetry))
     if getattr(args, "blackbox_dir", None):
         import types
 

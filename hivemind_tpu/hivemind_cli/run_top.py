@@ -14,7 +14,12 @@ standing questions without Prometheus or Perfetto:
   request delta), p95 latency and sheds merged across every peer's serving
   section, per-peer saturation (queue depth, runtime utilization, decode
   session occupancy), degraded client-side scorecards, and the slowest-request
-  exemplars with their queue/assembly/compute/serialize decomposition.
+  exemplars with their queue/assembly/compute/serialize decomposition;
+- **device board** (``--device``, ISSUE 19) — per-peer jit compiles (count,
+  storms, compile-seconds), HBM residency (live/peak bytes, buffer count),
+  host<->device transfer totals, and the comm/compute overlap efficiency from
+  the step timeline, plus the swarm's hottest compile sites. Recompile storms
+  and suspected HBM leaks surface as alerts.
 
 Everything renders from the DHT-published snapshots (`--key` must match the
 swarm's ``TelemetryPublisher`` key), so the dashboard is a pure *reader*: it
@@ -311,6 +316,97 @@ def render_serving_board(
     return "\n".join(lines), request_state
 
 
+def _mib(nbytes: Any) -> str:
+    try:
+        return f"{float(nbytes) / 2**20:.1f}"
+    except (TypeError, ValueError):
+        return "-"
+
+
+def render_device_board(records: Dict[str, Dict[str, Any]], *, ansi: bool = True) -> str:
+    """The ``--device`` board (ISSUE 19). Pure: no DHT, no IO. Renders each
+    peer's ``device`` snapshot section — live DHT snapshots and ``--from-spool``
+    replays emit the same shape, so dead peers render like live ones."""
+    bold = _BOLD if ansi else ""
+    red = _RED if ansi else ""
+    reset = _RESET if ansi else ""
+
+    lines: List[str] = [f"{bold}device board{reset} — jit compiles / HBM / transfers / overlap"]
+    header = (
+        f"{'peer':<18} {'compiles':>8} {'storms':>6} {'jit s':>7} {'HBM MiB':>8} "
+        f"{'peak MiB':>9} {'bufs':>5} {'h2d MiB':>8} {'d2h MiB':>8} {'ovl %':>6}"
+    )
+    lines.append(bold + header + reset)
+    rows: List[str] = []
+    alerts: List[str] = []
+    site_board: Dict[str, List[float]] = {}  # site -> [count, seconds]
+
+    for peer, snapshot in sorted(records.items(), key=lambda kv: str(kv[0])):
+        device = snapshot.get("device") if isinstance(snapshot, dict) else None
+        if not isinstance(device, dict) or not device:
+            continue
+        # snapshots are DHT/spool-supplied: a malformed device section gets a
+        # flagged row, never a dead board (same contract as render_frame)
+        try:
+            compiles = device.get("compiles") or {}
+            total = int(compiles.get("total") or 0)
+            storms = int(compiles.get("storms") or 0)
+            seconds = float(compiles.get("seconds") or 0.0)
+            memory = device.get("memory") or {}
+            peak = max(
+                (int(entry.get("peak_bytes") or 0) for entry in (memory.get("devices") or {}).values()),
+                default=None,
+            )
+            transfers = device.get("transfer_bytes") or {}
+            overlap = device.get("overlap") or {}
+            mean_overlap = overlap.get("mean")
+
+            storm_field = f"{storms:>6}"
+            rows.append(
+                f"{str(peer)[:18]:<18} {total:>8} "
+                + (f"{red}{storm_field}{reset}" if storms else storm_field)
+                + f" {seconds:>7.2f} {_mib(memory.get('total_bytes')):>8} "
+                f"{(_mib(peak) if peak is not None else '-'):>9} "
+                f"{(memory.get('buffers') if memory.get('buffers') is not None else '-'):>5} "
+                f"{_mib(transfers.get('host_to_device')):>8} "
+                f"{_mib(transfers.get('device_to_host')):>8} "
+                f"{(f'{mean_overlap * 100:.1f}' if mean_overlap is not None else '-'):>6}"
+            )
+            for site, stats in (compiles.get("sites") or {}).items():
+                entry = site_board.setdefault(str(site), [0, 0.0])
+                entry[0] += int((stats or {}).get("count") or 0)
+                entry[1] += float((stats or {}).get("seconds") or 0.0)
+            if storms:
+                last = compiles.get("last") or {}
+                alerts.append(
+                    f"{red}recompile-storm{reset} {str(peer)[:16]}: {storms} storm(s), "
+                    f"last compile at {last.get('site', '?')}"
+                )
+            if device.get("leaks_suspected"):
+                alerts.append(
+                    f"{red}hbm-leak{reset} {str(peer)[:16]}: "
+                    f"{device['leaks_suspected']} suspected leak episode(s)"
+                )
+        except Exception as e:
+            logger.debug(f"malformed device section from {peer!r}: {e!r}")
+            rows.append(f"{str(peer)[:18]:<18} {red}<malformed device section>{reset}")
+
+    if not rows:
+        lines.append("  (no device telemetry reported by any peer)")
+    lines.extend(rows[:20])
+    if site_board:
+        ranked = sorted(site_board.items(), key=lambda kv: (-kv[1][0], kv[0]))
+        lines.append(f"{bold}hot compile sites (merged across peers){reset}")
+        lines.extend(
+            f"  {site[:40]:<40} x{int(count):>4}  {seconds:>7.2f}s"
+            for site, (count, seconds) in ranked[:6]
+        )
+    if alerts:
+        lines.append(f"{bold}device alerts{reset}")
+        lines.extend(f"  {alert}" for alert in alerts[-8:])
+    return "\n".join(lines)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
@@ -330,6 +426,10 @@ def main() -> None:
     parser.add_argument("--serving", action="store_true",
                         help="append the serving board: per-expert QPS/p95/sheds, "
                              "saturation, scorecards, slowest-request exemplars")
+    parser.add_argument("--device", action="store_true",
+                        help="append the device board: jit compiles/storms, HBM "
+                             "live/peak bytes, host<->device transfer totals, "
+                             "comm/compute overlap efficiency")
     parser.add_argument("--from-spool", nargs="+", default=None, dest="from_spool",
                         metavar="DIR",
                         help="replay mode for dead swarms: render one frame from "
@@ -354,6 +454,12 @@ def main() -> None:
             now=newest or None,
             ansi=not args.no_ansi,
         )
+        # post-mortems are one frame with no space pressure: always show the
+        # victim's device state (last compiles / HBM at death) when spooled
+        if args.device or any(
+            isinstance(s, dict) and s.get("device") for s in records.values()
+        ):
+            frame = f"{frame}\n\n{render_device_board(records, ansi=not args.no_ansi)}"
         print(frame, flush=True)
         return
 
@@ -383,6 +489,8 @@ def main() -> None:
                     records, prev_requests=prev_requests, ansi=not args.no_ansi
                 )
                 frame = f"{frame}\n\n{board}"
+            if args.device:
+                frame = f"{frame}\n\n{render_device_board(records, ansi=not args.no_ansi)}"
             print(frame, flush=True)
             rendered += 1
             if args.frames and rendered >= args.frames:

@@ -40,8 +40,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
+from hivemind_tpu.telemetry.device import record_transfer
 from hivemind_tpu.utils.logging import get_logger
 from hivemind_tpu.utils.asyncio_utils import spawn
+from hivemind_tpu.utils.profiling import tracked_jit
 
 logger = get_logger(__name__)
 
@@ -170,7 +172,12 @@ class DecodeSessionManager:
         key = (uid, batch, new_len)
         fn = self._step_fns.get(key)
         if fn is None:
-            fn = self._step_fns[key] = jax.jit(self._raw_step(uid), donate_argnums=(2, 3))
+            # tracked_jit (ISSUE 19): every compile lands on the compile tracker
+            # under one site — a client cycling prompt lengths past the pow2
+            # buckets shows up as a recompile storm, not silent latency
+            fn = self._step_fns[key] = tracked_jit(
+                self._raw_step(uid), site="decode_session.step", donate_argnums=(2, 3)
+            )
         return fn
 
     def decode(self, uid: str, session_id: str, x: np.ndarray, reset: bool) -> np.ndarray:
@@ -236,6 +243,7 @@ class DecodeSessionManager:
             if padded_len != new_len:
                 x = np.pad(x, ((0, 0), (0, padded_len - new_len), (0, 0)))
             step = self._step_fn(uid, batch, padded_len)
+            record_transfer(x.nbytes, "host_to_device")
             y, session.cache_k, session.cache_v = step(
                 backend.snapshot_params(), jnp.asarray(x), session.cache_k,
                 session.cache_v, jnp.int32(session.index),
@@ -248,7 +256,9 @@ class DecodeSessionManager:
             # Bare float store; concurrent readers just see one of two recent stamps.
             session.last_used = time.monotonic()
             _STEPS.inc(path="direct")
-            return np.asarray(y)[:, :new_len]
+            out = np.asarray(y)[:, :new_len]
+            record_transfer(out.nbytes, "device_to_host")
+            return out
 
     # ---- continuous batching of single-token steps across sessions ------------
 
@@ -405,8 +415,9 @@ class DecodeSessionManager:
         key = (uid, stack)
         fn = self._batched_fns.get(key)
         if fn is None:
-            fn = self._batched_fns[key] = jax.jit(
+            fn = self._batched_fns[key] = tracked_jit(
                 jax.vmap(self._raw_step(uid), in_axes=(None, 0, 0, 0, 0)),
+                site="decode_session.batched_step",
                 donate_argnums=(2, 3),
             )
         return fn
@@ -452,6 +463,7 @@ class DecodeSessionManager:
                 [i] = live
                 _future, session, x = entries[i]
                 step = self._step_fn(uid, 1, 1)
+                record_transfer(int(x.nbytes), "host_to_device")
                 y, session.cache_k, session.cache_v = step(
                     backend.snapshot_params(), jnp.asarray(x), session.cache_k,
                     session.cache_v, jnp.int32(session.index),
@@ -462,6 +474,7 @@ class DecodeSessionManager:
                 # defines `batched` as merged into a vmapped continuous batch)
                 _STEPS.inc(path="direct")
                 results[i] = np.asarray(y)[:, :1]
+                record_transfer(results[i].nbytes, "device_to_host")
                 return results
             stack = _next_pow2(len(live))
             dummy_k, dummy_v = self._dummy_rows(uid)
@@ -478,11 +491,15 @@ class DecodeSessionManager:
                 cvs.append(dummy_v)
                 idxs.append(1)  # a valid mid-cache position; output is discarded
             step = self._batched_fn(uid, stack)
+            # xs rows originate host-side (one per live client step); caches are
+            # already resident, so only the stacked activations count as h2d
+            record_transfer(sum(int(x.nbytes) for x in xs), "host_to_device")
             y, new_k, new_v = step(
                 backend.snapshot_params(), jnp.stack(xs), jnp.stack(cks), jnp.stack(cvs),
                 jnp.asarray(idxs, jnp.int32),
             )
             y = np.asarray(y)
+            record_transfer(y.nbytes, "device_to_host")
             _STEPS.inc(len(live), path="batched")
             now = time.monotonic()
             for row, i in enumerate(live):

@@ -62,7 +62,9 @@ class MeshModuleBackend(ModuleBackend):
         shardings = jax.tree_util.tree_map(
             lambda s: NamedSharding(self.mesh, self.leaf_spec(s)), shapes
         )
-        return jax.jit(make, out_shardings=shardings)()
+        # one-shot init jit, called once per backend — compile tracking would
+        # only add noise to the per-site counters
+        return jax.jit(make, out_shardings=shardings)()  # lint: allow(jit-in-hot-path)
 
     # ------------------------------------------------------------------ shardings
 

@@ -16,7 +16,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from hivemind_tpu.compression import CompressionType
+from hivemind_tpu.telemetry.device import record_transfer
 from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.profiling import tracked_jit
 from hivemind_tpu.utils.tensor_descr import BatchTensorDescriptor
 
 logger = get_logger(__name__)
@@ -88,13 +90,16 @@ class ModuleBackend:
         def _as_tuple(value):
             return tuple(value) if isinstance(value, (tuple, list)) else (value,)
 
-        @jax.jit
+        # tracked_jit (ISSUE 19): per-bucket compiles show up on the compile
+        # tracker (sites are fixed strings — expert names would explode label
+        # cardinality; the signature on the compile record carries the shape)
+        @tracked_jit(site="module_backend.forward")
         def _forward(params, *xs):
             from hivemind_tpu.ops.quantized_params import dequantize_tree
 
             return _as_tuple(module.apply({"params": dequantize_tree(params)}, *xs))
 
-        @jax.jit
+        @tracked_jit(site="module_backend.backward")
         def _backward(params, opt_state, xs, grad_outs):
             import optax
 
@@ -156,8 +161,11 @@ class ModuleBackend:
         assert len(inputs) == self.num_inputs, (len(inputs), self.num_inputs)
         padded = [self._pad(np.asarray(x, np.float32)) for x in inputs]
         n = padded[0][1]
+        record_transfer(sum(int(p.nbytes) for p, _ in padded), "host_to_device")
         outs = self._jit_forward(self.snapshot_params(), *(p for p, _ in padded))
-        return [np.asarray(out)[:n] for out in outs]
+        results = [np.asarray(out)[:n] for out in outs]
+        record_transfer(sum(r.nbytes for r in results), "device_to_host")
+        return results
 
     def backward(self, *tensors: np.ndarray) -> List[np.ndarray]:
         """Gradients wrt every input; ALSO applies one optimizer update to the expert
@@ -174,6 +182,10 @@ class ModuleBackend:
         padded_x = [self._pad(np.asarray(x, np.float32)) for x in tensors[: self.num_inputs]]
         padded_g = [self._pad(np.asarray(g, np.float32)) for g in tensors[self.num_inputs :]]
         n = padded_x[0][1]
+        record_transfer(
+            sum(int(p.nbytes) for p, _ in padded_x) + sum(int(p.nbytes) for p, _ in padded_g),
+            "host_to_device",
+        )
         with self._state_lock:
             grad_xs, new_params, new_opt_state = self._jit_backward(
                 self.params,
@@ -183,7 +195,9 @@ class ModuleBackend:
             )
             self.params, self.opt_state = new_params, new_opt_state
             self.update_count += 1
-        return [np.asarray(g)[:n] for g in grad_xs]
+        grads_out = [np.asarray(g)[:n] for g in grad_xs]
+        record_transfer(sum(g.nbytes for g in grads_out), "device_to_host")
+        return grads_out
 
     # ------------------------------------------------------------------ metadata/state
 

@@ -34,6 +34,7 @@ from hivemind_tpu.optim.progress_tracker import ProgressTracker
 from hivemind_tpu.optim.recovery import LocalCheckpointStore, restore_from_local
 from hivemind_tpu.optim.state_averager import TrainingStateAverager
 from hivemind_tpu.telemetry import REGISTRY as _TELEMETRY
+from hivemind_tpu.telemetry.device import STEP_TIMELINE as _STEP_TIMELINE
 from hivemind_tpu.telemetry.ledger import LEDGER as _LEDGER
 from hivemind_tpu.telemetry.tracing import trace as _tracing_span
 from hivemind_tpu.utils.logging import get_logger
@@ -308,7 +309,11 @@ class Optimizer(ChronicFailureTracking):
         (reference use_local_updates, optimizer.py:143-145)."""
         assert self.state_averager is not None
         if grads is not None:
-            self.state_averager.apply_optimizer_step(grads)
+            # the compute lane of the step timeline (ISSUE 19): a delayed
+            # state-averaging round overlapping these spans is the overlap
+            # efficiency being measured
+            with _tracing_span("optimizer.update", peer=str(self.dht.peer_id)):
+                self.state_averager.apply_optimizer_step(grads)
         new_samples = self.tracker.local_progress.samples_accumulated + batch_size
         self.tracker.report_local_progress(self.local_epoch, new_samples)
         if self.tracker.ready_to_update_epoch:
@@ -380,6 +385,9 @@ class Optimizer(ChronicFailureTracking):
         next_epoch = max(self.local_epoch + 1, self.tracker.global_epoch)
 
         averaged_ok: Optional[bool] = None  # None = no round attempted (solo swarm)
+        # step timeline (ISSUE 19): grads are ready HERE; everything between
+        # this mark and the update landing is communication to hide
+        _STEP_TIMELINE.note_grad_ready(str(self.dht.peer_id))
         if self.tracker.global_progress.num_peers > 1:
             averaged_ok = False
             control = None if self._scheduled_control_invalid() else self.scheduled_grads
@@ -402,7 +410,8 @@ class Optimizer(ChronicFailureTracking):
             self.grad_averager.load_accumulators_into_averager_()
 
         with self.grad_averager.use_averaged_gradients() as averaged_grads:
-            self.state_averager.apply_optimizer_step(list(averaged_grads))
+            with _tracing_span("optimizer.update", peer=str(self.dht.peer_id), epoch=next_epoch):
+                self.state_averager.apply_optimizer_step(list(averaged_grads))
         self.grad_averager.reset_accumulated_grads_()
         self._finish_epoch_transition(next_epoch, averaged_ok)
 
@@ -453,6 +462,7 @@ class Optimizer(ChronicFailureTracking):
         # into the in-flight round (shared buffers hold this epoch's local average,
         # which doubles as the fallback if swarm averaging fails)
         self.grad_averager.load_accumulators_into_averager_()
+        _STEP_TIMELINE.note_grad_ready(str(self.dht.peer_id))
         # weight 0 is correct for a peer with nothing accumulated: its zero buffers
         # must not dilute the group average (matches the synchronous path)
         weight = float(self.grad_averager.local_samples_accumulated)
@@ -481,7 +491,8 @@ class Optimizer(ChronicFailureTracking):
             except Exception as e:
                 logger.warning(f"delayed gradient averaging failed ({e!r}); applying local gradients")
         with self.grad_averager.use_averaged_gradients() as averaged_grads:
-            self.state_averager.apply_optimizer_step(list(averaged_grads))
+            with _tracing_span("optimizer.update", peer=str(self.dht.peer_id), epoch=next_epoch):
+                self.state_averager.apply_optimizer_step(list(averaged_grads))
         self._finish_epoch_transition(next_epoch, averaged_ok)
 
     def _finish_pending_update(self, timeout: Optional[float] = None) -> None:

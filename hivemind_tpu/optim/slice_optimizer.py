@@ -79,6 +79,7 @@ from hivemind_tpu.optim.grad_averager import GradientAverager
 from hivemind_tpu.optim.progress_tracker import ProgressTracker
 from hivemind_tpu.parallel.ici import MeshTensorBridge
 from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.profiling import tracked_jit
 from hivemind_tpu.utils.timed_storage import get_dht_time
 
 logger = get_logger(__name__)
@@ -253,9 +254,14 @@ class SliceOptimizer(ChronicFailureTracking):
         def _normalize(acc, inv_scale):
             return jax.tree_util.tree_map(lambda a: a * inv_scale, acc)
 
-        self._jit_accumulate = jax.jit(_accumulate, donate_argnums=(0,))
-        self._jit_apply = jax.jit(_apply, donate_argnums=(0, 1))
-        self._jit_normalize = jax.jit(_normalize)
+        # tracked_jit (ISSUE 19): these three are the slice's hottest device
+        # calls — a retrace here (e.g. a dtype drift in the grads tree) must
+        # surface on the compile tracker, not hide as a slow step
+        self._jit_accumulate = tracked_jit(
+            _accumulate, site="slice_optimizer.accumulate", donate_argnums=(0,)
+        )
+        self._jit_apply = tracked_jit(_apply, site="slice_optimizer.apply", donate_argnums=(0, 1))
+        self._jit_normalize = tracked_jit(_normalize, site="slice_optimizer.normalize")
 
         # -------- networking (process 0 only) --------
         self.dht = None
@@ -319,10 +325,11 @@ class SliceOptimizer(ChronicFailureTracking):
     def _jit_zeros_like(self):
         fn = getattr(self, "_zeros_fn", None)
         if fn is None:
-            fn = self._zeros_fn = jax.jit(
+            fn = self._zeros_fn = tracked_jit(
                 lambda tree: jax.tree_util.tree_map(
                     lambda x: jnp.zeros(x.shape, jnp.float32), tree
-                )
+                ),
+                site="slice_optimizer.zeros_like",
             )
         return fn
 

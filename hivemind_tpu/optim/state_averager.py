@@ -20,7 +20,9 @@ from hivemind_tpu.averaging.averager import DecentralizedAverager
 from hivemind_tpu.compression.base import as_numpy
 from hivemind_tpu.dht import DHT
 from hivemind_tpu.optim.recovery import _STATE_RESTORES
+from hivemind_tpu.telemetry.device import record_transfer
 from hivemind_tpu.utils.logging import get_logger
+from hivemind_tpu.utils.profiling import tracked_jit
 
 logger = get_logger(__name__)
 
@@ -75,7 +77,7 @@ class TrainingStateAverager(DecentralizedAverager):
             and np.asarray(leaf).ndim >= 1
         ]
 
-        @jax.jit
+        @tracked_jit(site="state_averager.apply")
         def _apply(params_flat, opt_state, grads_flat):
             params_tree = jax.tree_util.tree_unflatten(self._params_treedef, params_flat)
             grads_tree = jax.tree_util.tree_unflatten(self._params_treedef, grads_flat)
@@ -113,6 +115,10 @@ class TrainingStateAverager(DecentralizedAverager):
         opt_leaves = self._opt_leaves()
         tensors += [np.asarray(as_numpy(opt_leaves[i]), dtype=np.float32) for i in self._averaged_opt_indices]
         tensors += [np.asarray(t, dtype=np.float32) for t in self.extra_tensors]
+        # host staging IS the transport path (module docstring): every round
+        # device_gets the whole averageable state — the d2h side of ISSUE 19's
+        # transfer accounting on the averaging boundary
+        record_transfer(sum(t.nbytes for t in tensors), "device_to_host")
         return tensors
 
     def _load_host_state_tensors(self, tensors: List[np.ndarray]) -> None:
@@ -124,6 +130,7 @@ class TrainingStateAverager(DecentralizedAverager):
         n_params = len(self._params_flat)
         n_opt = len(self._averaged_opt_indices)
         assert len(tensors) >= n_params + n_opt, "state tensor count mismatch"
+        record_transfer(sum(int(t.nbytes) for t in tensors), "host_to_device")
         with self._state_lock:
             self._params_flat = [
                 jnp.asarray(tensor, dtype=p.dtype)

@@ -26,9 +26,32 @@ distributed tracing with a per-process flight recorder.
   frames) fed from the span/ledger hooks, read back by ``hivemind-blackbox``
   and ``hivemind-top --from-spool`` for cross-peer post-mortems.
 
+- :mod:`~hivemind_tpu.telemetry.device` — device-side observability
+  (ISSUE 19): the jit compile tracker + recompile-storm detector, device
+  memory/leak/transfer telemetry sampled by the watchdog tick, and the
+  StepTimeline's comm/compute overlap-efficiency scoring (ROADMAP item 2's
+  yardstick).
+
 See docs/observability.md for the metric catalog and the span catalog.
 """
 
+from hivemind_tpu.telemetry.device import (
+    COMPILE_TRACKER,
+    MEMORY_MONITOR,
+    STEP_TIMELINE,
+    DeviceMemoryMonitor,
+    JitCompileTracker,
+    StepTimeline,
+    add_device_listener,
+    arm_device_telemetry,
+    device_snapshot,
+    device_telemetry_armed,
+    disarm_device_telemetry,
+    record_transfer,
+    remove_device_listener,
+    reset_device_telemetry,
+    span_lane,
+)
 from hivemind_tpu.telemetry.blackbox import (
     BlackBox,
     SpoolWriter,
@@ -82,6 +105,21 @@ from hivemind_tpu.telemetry.registry import (
 __all__ = [
     "REGISTRY",
     "RECORDER",
+    "COMPILE_TRACKER",
+    "MEMORY_MONITOR",
+    "STEP_TIMELINE",
+    "JitCompileTracker",
+    "DeviceMemoryMonitor",
+    "StepTimeline",
+    "add_device_listener",
+    "remove_device_listener",
+    "arm_device_telemetry",
+    "disarm_device_telemetry",
+    "device_telemetry_armed",
+    "device_snapshot",
+    "record_transfer",
+    "reset_device_telemetry",
+    "span_lane",
     "BlackBox",
     "SpoolWriter",
     "read_spool",

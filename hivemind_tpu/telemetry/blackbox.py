@@ -20,7 +20,9 @@ Spool format — bounded, segment-rotated, torn-tail tolerant:
   every segment: peer, segment index, wall anchor + drift estimate, clock
   model), ``span`` (finished), ``span_start`` (open — the only way a victim's
   last operation reaches disk), ``ledger_round``, ``ledger_epoch``,
-  ``serving``, ``metrics``;
+  ``serving``, ``metrics``, ``device`` (ISSUE 19: compile / recompile-storm /
+  device-memory / leak / overlap events — device telemetry is process-scoped,
+  so these frames bypass ``peer_filter`` and land in every co-resident box);
 - retention is a segment-count cap: the oldest ``.seg`` is deleted when the
   cap is exceeded, so a spool is O(retention × segment_bytes) forever.
 
@@ -39,6 +41,7 @@ from __future__ import annotations
 import os
 import struct
 import threading
+import time
 import zlib
 from pathlib import Path
 from typing import Any, Dict, Iterator, List, Optional, Tuple
@@ -321,6 +324,13 @@ class BlackBox:
             add_span_start_listener(self._on_span_start)
         self._ledger.add_record_listener(self._on_ledger_record)
         self._serving_ledger.add_record_listener(self._on_serving_record)
+        # device telemetry (ISSUE 19) is process-scoped (one jit cache, one
+        # HBM pool), so device frames deliberately BYPASS peer_filter: every
+        # co-resident box carries the compile/memory state a post-mortem needs
+        from hivemind_tpu.telemetry.device import add_device_listener
+
+        self._last_device_memory_frame = 0.0
+        add_device_listener(self._on_device_record)
         if metrics_interval is not None:
             self._metrics_thread = threading.Thread(
                 target=self._metrics_loop,
@@ -358,6 +368,24 @@ class BlackBox:
             return
         self.writer.append("serving", record)
 
+    def _on_device_record(self, kind: str, record: Dict[str, Any]) -> None:
+        # memory samples arrive on every watchdog tick — throttle them so a
+        # long-lived box doesn't rotate its whole retention on gauge chatter;
+        # the rare kinds (compile/storm/leak/overlap) always spool
+        if kind == "memory":
+            now = time.monotonic()
+            if now - self._last_device_memory_frame < 5.0:
+                return
+            self._last_device_memory_frame = now
+        frame = dict(record)
+        # overlap records carry their comm span's name under "kind" — keep it
+        # as "span" so the frame's own kind discriminator survives the merge
+        inner = frame.pop("kind", None)
+        if inner is not None:
+            frame["span"] = inner
+        frame["kind"] = kind
+        self.writer.append("device", frame)
+
     def _metrics_loop(self, interval: float) -> None:
         while not self._stop.wait(interval):
             self.snapshot_metrics()
@@ -368,6 +396,9 @@ class BlackBox:
             remove_span_start_listener(self._on_span_start)
         self._ledger.remove_record_listener(self._on_ledger_record)
         self._serving_ledger.remove_record_listener(self._on_serving_record)
+        from hivemind_tpu.telemetry.device import remove_device_listener
+
+        remove_device_listener(self._on_device_record)
         if self._metrics_thread is not None:
             self._metrics_thread.join(timeout=2.0)
             self._metrics_thread = None

@@ -83,6 +83,14 @@ def build_peer_snapshot(
     watchdog = watchdog_summary()
     if watchdog.get("loops"):
         snapshot["watchdog"] = watchdog
+    # device-side observability (ISSUE 19): compile counts / HBM / transfer /
+    # overlap ride the snapshot so hivemind-top's device board renders from ONE
+    # DHT read; empty dict when the process never touched an accelerator
+    from hivemind_tpu.telemetry.device import device_snapshot
+
+    device = device_snapshot()
+    if device:
+        snapshot["device"] = device
     if extras:
         snapshot.update(extras)
     return snapshot
@@ -112,6 +120,18 @@ def _shrink_to_fit(snapshot: Dict[str, Any], max_bytes: int = _MAX_SNAPSHOT_BYTE
         if len(MSGPackSerializer.dumps(candidate)) <= max_bytes:
             return candidate
         snapshot = candidate
+    # device section shrinks before it drops: headline compile/HBM/overlap
+    # numbers survive as a compact dict, per-site/per-device detail goes
+    device = snapshot.get("device")
+    if isinstance(device, dict) and device:
+        from hivemind_tpu.telemetry.device import compact_device_snapshot
+
+        compacted = compact_device_snapshot(device)
+        if compacted != device:
+            candidate = {**snapshot, "device": compacted, "truncated": True}
+            if len(MSGPackSerializer.dumps(candidate)) <= max_bytes:
+                return candidate
+            snapshot = candidate
     # span summaries are nice-to-have context: they go first
     for optional_key in ("recent_spans", "slow_spans"):
         if optional_key in snapshot:
@@ -132,7 +152,9 @@ def _shrink_to_fit(snapshot: Dict[str, Any], max_bytes: int = _MAX_SNAPSHOT_BYTE
         if len(MSGPackSerializer.dumps(shrunk)) <= max_bytes:
             return shrunk
     snapshot = {**snapshot, "metrics": metrics}
-    for optional_key in ("serving", "ledger"):
+    # the (already compacted) device section drops before serving/ledger: its
+    # headline numbers are re-derivable from metrics, attribution records aren't
+    for optional_key in ("device", "serving", "ledger"):
         if optional_key in snapshot:
             snapshot = {k: v for k, v in snapshot.items() if k != optional_key}
             snapshot["truncated"] = True

@@ -492,6 +492,22 @@ class trace:
 # ---------------------------------------------------------------------- export
 
 
+# fixed tids for the compute-vs-comm lanes (ISSUE 19); real thread ids are
+# huge, so small constants cannot collide in practice
+_LANE_TIDS = {"compute": 1, "comm": 2}
+
+
+def _span_lane(name: str) -> Optional[str]:
+    """Lazy bridge to device.span_lane — device.py imports tracing, so tracing
+    must not import it back at module scope."""
+    try:
+        from hivemind_tpu.telemetry.device import span_lane
+
+        return span_lane(name)
+    except Exception:
+        return None
+
+
 def render_chrome_trace(
     spans: Optional[Iterable[Span]] = None, default_peer: str = "local"
 ) -> Dict[str, Any]:
@@ -499,12 +515,16 @@ def render_chrome_trace(
     form; opens directly in Perfetto / ``chrome://tracing``).
 
     pid/tid mapping: each distinct ``peer`` span attribute becomes one pid row
-    (named via ``process_name`` metadata); tids are the recording threads. Span
+    (named via ``process_name`` metadata); tids are the recording threads,
+    EXCEPT comm/compute spans (ISSUE 19): those land on two fixed named lanes
+    per peer — ``compute`` (tid 1) and ``comm`` (tid 2) — so the overlap the
+    StepTimeline scores is visible as two stacked rows in Perfetto. Span
     events render as instant events on the same row, and every event carries
     its trace/span/parent ids in ``args`` so traces remain greppable."""
     spans = RECORDER.snapshot() if spans is None else list(spans)
     anchor = wall_anchor()
     peers: Dict[str, int] = {}
+    lanes_used: set = set()  # (pid, lane)
     events: List[Dict[str, Any]] = []
     for span in spans:
         peer = default_peer
@@ -525,11 +545,18 @@ def render_chrome_trace(
             args.update(
                 {k: v for k, v in span.attributes.items() if isinstance(v, (str, int, float, bool))}
             )
+        lane = _span_lane(span.name)
+        if lane is not None:
+            tid = _LANE_TIDS[lane]
+            args["lane"] = lane
+            lanes_used.add((pid, lane))
+        else:
+            tid = span.thread_id % 2**31
         events.append(
             {
                 "name": span.name, "cat": "span", "ph": "X",
                 "ts": round(ts_us, 3), "dur": round(dur_us, 3),
-                "pid": pid, "tid": span.thread_id % 2**31, "args": args,
+                "pid": pid, "tid": tid, "args": args,
             }
         )
         for when, event_name, event_attrs in span.events or ():
@@ -540,7 +567,7 @@ def render_chrome_trace(
                 {
                     "name": event_name, "cat": "event", "ph": "i", "s": "t",
                     "ts": round((when + anchor) * 1e6, 3),
-                    "pid": pid, "tid": span.thread_id % 2**31, "args": instant_args,
+                    "pid": pid, "tid": tid, "args": instant_args,
                 }
             )
     for peer, pid in peers.items():
@@ -548,6 +575,13 @@ def render_chrome_trace(
             {
                 "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
                 "args": {"name": f"peer {peer}"},
+            }
+        )
+    for pid, lane in sorted(lanes_used):
+        events.append(
+            {
+                "name": "thread_name", "ph": "M", "pid": pid,
+                "tid": _LANE_TIDS[lane], "args": {"name": lane},
             }
         )
     return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -72,6 +72,35 @@ _EXECUTOR_DEPTH = REGISTRY.gauge(
 )
 
 
+# extra per-tick samplers (ISSUE 19): device telemetry registers its memory
+# probe here so HBM gauges ride the existing watchdog cadence instead of
+# growing another daemon thread. Samplers must be cheap and never instantiate
+# lazy state (same discipline as _executor_queue_depths).
+_TICK_SAMPLERS: List = []
+
+
+def add_tick_sampler(sampler) -> None:
+    """Register a zero-arg callable invoked on every watchdog tick (all
+    watchdogs). Idempotent; exceptions are swallowed per tick."""
+    if sampler not in _TICK_SAMPLERS:
+        _TICK_SAMPLERS.append(sampler)
+
+
+def remove_tick_sampler(sampler) -> None:
+    try:
+        _TICK_SAMPLERS.remove(sampler)
+    except ValueError:
+        pass
+
+
+def _run_tick_samplers() -> None:
+    for sampler in list(_TICK_SAMPLERS):
+        try:
+            sampler()
+        except Exception as e:
+            logger.debug(f"watchdog tick sampler failed: {e!r}")
+
+
 def _executor_queue_depths() -> Dict[str, int]:
     """Backlogs of the shared pools; only pools that already exist are sampled
     (peeking must never instantiate an executor)."""
@@ -151,6 +180,7 @@ class EventLoopWatchdog:
             if not self._tick():
                 break
             self._sample_executors()
+            _run_tick_samplers()
             self._stop.wait(self.interval)
 
     def _tick(self) -> bool:

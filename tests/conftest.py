@@ -110,6 +110,7 @@ def cleanup_children(request):
     from hivemind_tpu.resilience import CHAOS, reset_all_boards
     from hivemind_tpu.telemetry import watchdog as telemetry_watchdog
     from hivemind_tpu.telemetry.blackbox import disarm_blackbox
+    from hivemind_tpu.telemetry.device import reset_device_telemetry
     from hivemind_tpu.telemetry.ledger import LEDGER
     from hivemind_tpu.telemetry.serving import SCORECARDS, SERVING_LEDGER
     from hivemind_tpu.telemetry.tracing import RECORDER
@@ -123,6 +124,7 @@ def cleanup_children(request):
     LEDGER.clear()  # one test's round records must not satisfy another's assertions
     SERVING_LEDGER.clear()  # serving records + expert scorecards likewise
     SCORECARDS.clear()
+    reset_device_telemetry()  # compile counts/memory trend/timeline + disarm
     telemetry_watchdog.shutdown_all()  # watchdog threads re-arm with the next loop owner
     Ed25519PrivateKey.reset_process_wide()
     gc.collect()

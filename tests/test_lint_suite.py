@@ -3,7 +3,7 @@
 Three layers of coverage:
 
 1. the real tree is CLEAN — zero unsuppressed findings, zero stale allowlist
-   entries, whole 9-rule suite inside the tier-1 time budget;
+   entries, whole 10-rule suite inside the tier-1 time budget;
 2. every rule actually catches what it claims to catch (MUST-flag fixtures in
    ``tools/lint/fixtures/<rule>/flag.py``, each tied to a named historical bug
    class) and does not cry wolf on the approved pattern (``ok.py``);
@@ -71,6 +71,7 @@ _AST_CASES = [
     ("adhoc-retries", "utils/mod.py", {"swallow", "retry-loop"}),
     ("blocking-in-async", "p2p/mod.py", {"time-sleep", "blocking-io", "sync-socket"}),
     ("hotpath-copies", "p2p/mux.py", {"bytes-concat", "copy-astype"}),
+    ("jit-in-hot-path", "moe/mod.py", {"inline-jit"}),
     ("async-shared-state", "averaging/mod.py", {"interleaved:followers", "interleaved:pending"}),
     ("fire-and-forget", "p2p/mod.py", {"dropped-task"}),
     ("missing-deadline", "moe/mod.py", {"no-deadline"}),
@@ -143,7 +144,7 @@ def test_project_rule_passes_its_synced_tree(tmp_path, rule_name, expected):
 
 
 def test_every_rule_ships_must_flag_and_must_pass_fixtures():
-    """All nine rules carry checked-in fixtures: file pairs for the AST rules,
+    """All ten rules carry checked-in fixtures: file pairs for the AST rules,
     mini-repo trees for the cross-file project rules."""
     covered = {case[0] for case in _AST_CASES} | {case[0] for case in _TREE_CASES}
     assert covered == {rule_cls.name for rule_cls in ALL_RULES}
@@ -397,11 +398,11 @@ def test_cli_clean_tree_exits_zero(tmp_path, capsys):
     assert "clean" in capsys.readouterr().out
 
 
-def test_cli_lists_all_nine_rules(capsys):
+def test_cli_lists_all_ten_rules(capsys):
     assert cli.main(["--list-rules"]) == 0
     listed = [line.split()[0] for line in capsys.readouterr().out.splitlines() if line]
     assert listed == [rule_cls.name for rule_cls in ALL_RULES]
-    assert len(listed) == 9
+    assert len(listed) == 10
 
 
 def test_cli_rejects_unknown_rule():

@@ -1,6 +1,6 @@
 """hivemind-lint: the unified static-analysis suite (ISSUE 16).
 
-One AST-walk engine (`lint.engine`), nine rules (`lint.rules`), one console
+One AST-walk engine (`lint.engine`), ten rules (`lint.rules`), one console
 entry point (`hivemind-lint`, `lint.cli`) and one tier-1 pytest entry
 (tests/test_lint_suite.py). Rules share:
 

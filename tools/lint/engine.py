@@ -13,7 +13,7 @@ Key objects:
   applies suppressions and allowlists centrally, so no rule reimplements them.
 - :func:`run_suite` — runs rules, partitions findings into violations /
   suppressed / allowlisted, reports stale allowlist entries, and times each
-  rule (the whole 9-rule suite must stay under the tier-1 budget).
+  rule (the whole 10-rule suite must stay under the tier-1 budget).
 
 Findings are keyed ``(repo-relative path, enclosing qualname, kind)`` — stable
 across line-number churn, same convention the old checkers used.

@@ -1,0 +1,17 @@
+"""MUST-flag fixture for ``jit-in-hot-path``: a fresh ``jax.jit`` inside a
+hot-path function body recompiles per call and bypasses compile accounting."""
+
+import jax
+from jax import jit
+
+
+def forward(params, x):
+    step = jax.jit(lambda p, v: p @ v)  # fresh jit object EVERY call
+    return step(params, x)
+
+
+class Backend:
+    def apply(self, params, grads):
+        # stashing on self still skips hivemind_device_compiles_total
+        self._apply = jit(lambda p, g: p - g)
+        return self._apply(params, grads)

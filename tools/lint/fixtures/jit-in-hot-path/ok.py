@@ -1,0 +1,30 @@
+"""MUST-pass fixture for ``jit-in-hot-path``: the sanctioned homes for
+``jax.jit`` — module scope, ``__init__`` setup, cached factories — and the
+preferred ``tracked_jit`` wrapper on the hot path itself."""
+
+import functools
+
+import jax
+
+from hivemind_tpu.utils.profiling import tracked_jit
+
+_STEP = jax.jit(lambda p, v: p @ v)  # module scope: compiled once at import
+
+
+class Backend:
+    def __init__(self):
+        # one-time per-object setup (tracked_jit still preferred: it counts)
+        self._apply = jax.jit(lambda p, g: p - g)
+
+    def forward(self, params, x):
+        return _STEP(params, x)
+
+
+@functools.lru_cache(maxsize=None)
+def make_step(static_shape):
+    return jax.jit(lambda p, v: p @ v)  # one jit per static key, cached
+
+
+def hot(params, x):
+    # the hot-path idiom: compile-accounted jit with a stable site label
+    return tracked_jit(lambda p, v: p @ v, site="fixture.hot")(params, x)

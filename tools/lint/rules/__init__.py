@@ -1,7 +1,8 @@
-"""Rule registry: the full 9-rule hivemind-lint suite (ISSUE 16).
+"""Rule registry: the full 10-rule hivemind-lint suite (ISSUE 16; jit-in-hot-path
+added by ISSUE 19).
 
 Four ported from the old standalone checkers (tools/check_*.py, now deleted),
-five new analyzers. Order here is display order."""
+six new analyzers. Order here is display order."""
 
 from lint.rules.adhoc_retries import AdhocRetriesRule
 from lint.rules.async_shared_state import AsyncSharedStateRule
@@ -9,6 +10,7 @@ from lint.rules.blocking_in_async import BlockingInAsyncRule
 from lint.rules.chaos_coverage import ChaosCoverageRule
 from lint.rules.fire_and_forget import FireAndForgetRule
 from lint.rules.hotpath_copies import HotpathCopiesRule
+from lint.rules.jit_in_hot_path import JitInHotPathRule
 from lint.rules.metric_docs import MetricDocsRule
 from lint.rules.missing_deadline import MissingDeadlineRule
 from lint.rules.wire_drift import WireDriftRule
@@ -17,6 +19,7 @@ ALL_RULES = (
     AdhocRetriesRule,
     BlockingInAsyncRule,
     HotpathCopiesRule,
+    JitInHotPathRule,
     MetricDocsRule,
     AsyncSharedStateRule,
     FireAndForgetRule,

@@ -1,0 +1,122 @@
+"""Rule ``jit-in-hot-path``: no untracked ``jax.jit`` inside hot-path bodies.
+
+A ``jax.jit(...)`` call that executes inside a function body under the
+``moe``/``averaging``/``optim`` trees builds a FRESH jitted callable — and a
+fresh compile cache — every time that function runs. Two failure modes, both
+seen in this repo's history (ISSUE 19):
+
+- a per-call jit recompiles on every invocation: the 79-241 µs optimizer step
+  becomes a multi-second step, silently;
+- even a jit that is stashed on ``self`` bypasses compile accounting, so
+  ``hivemind_device_compiles_total`` and the recompile-storm detector never
+  see it.
+
+The sanctioned homes for ``jax.jit``:
+
+- module scope (compiled once at import);
+- ``__init__`` (one-time per-object setup — though ``tracked_jit`` is still
+  preferred so the compile is counted);
+- an ``lru_cache``/``cache``-decorated factory (one jit per static key);
+- :func:`hivemind_tpu.utils.profiling.tracked_jit`, which wraps ``jax.jit``
+  with per-site compile accounting and is what hot paths should use.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from lint.engine import AstRule, Finding, ParsedModule, ScopedVisitor
+
+_CACHE_DECORATORS = {"lru_cache", "cache", "cached_property"}
+
+
+def _decorator_name(node: ast.AST) -> Optional[str]:
+    """Terminal name of a decorator: ``functools.lru_cache(maxsize=1)`` ->
+    ``lru_cache``."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _jit_aliases(tree: ast.Module) -> Set[str]:
+    """Bare names that are jax's jit in this module (``from jax import jit``)."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module in ("jax", "jax.experimental.pjit"):
+            for alias in node.names:
+                if alias.name in ("jit", "pjit"):
+                    aliases.add(alias.asname or alias.name)
+    return aliases
+
+
+class _Visitor(ScopedVisitor):
+    def __init__(self, rule: "JitInHotPathRule", module: ParsedModule):
+        super().__init__(module)
+        self.rule = rule
+        self.findings: List[Finding] = []
+        self.aliases = _jit_aliases(module.tree)
+        self._func_nodes: List[ast.AST] = []
+
+    # track the actual function nodes (ScopedVisitor only keeps names) so the
+    # exemptions can read the innermost function's name and decorators
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._func_nodes.append(node)
+        super().visit_FunctionDef(node)
+        self._func_nodes.pop()
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self._func_nodes.append(node)
+        super().visit_AsyncFunctionDef(node)
+        self._func_nodes.pop()
+
+    def _exempt_scope(self) -> bool:
+        if not self._func_nodes:
+            return True  # module/class scope: compiled once at import
+        innermost = self._func_nodes[-1]
+        if innermost.name == "__init__":
+            return True  # one-time per-object setup
+        return any(
+            _decorator_name(decorator) in _CACHE_DECORATORS
+            for decorator in innermost.decorator_list
+        )
+
+    def _is_jit(self, fn: ast.AST) -> bool:
+        if isinstance(fn, ast.Attribute) and fn.attr in ("jit", "pjit"):
+            # dotted chain rooted at `jax`: jax.jit, jax.experimental.pjit.pjit
+            root = fn.value
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            return isinstance(root, ast.Name) and root.id == "jax"
+        return isinstance(fn, ast.Name) and fn.id in self.aliases
+
+    def visit_Call(self, node: ast.Call):
+        if self._is_jit(node.func) and not self._exempt_scope():
+            self.findings.append(self.rule.finding(
+                self.module.relpath, node.lineno, self.qualname(), "inline-jit",
+                "jax.jit inside a hot-path function body recompiles per call and "
+                "bypasses compile accounting — use utils.profiling.tracked_jit"
+                "(site=...), or hoist to module/__init__ scope / an lru_cache "
+                "factory",
+            ))
+        self.generic_visit(node)
+
+
+class JitInHotPathRule(AstRule):
+    name = "jit-in-hot-path"
+    title = "no untracked jax.jit inside moe/averaging/optim function bodies"
+    rationale = (
+        "ISSUE 19: an inline jax.jit rebuilds its compile cache every call — a "
+        "silent 1000x step-time regression — and even a stashed one is invisible "
+        "to hivemind_device_compiles_total and the recompile-storm detector."
+    )
+    trees = ("moe", "averaging", "optim")
+
+    def check_module(self, module: ParsedModule) -> List[Finding]:
+        visitor = _Visitor(self, module)
+        visitor.visit(module.tree)
+        return visitor.findings
